@@ -1,0 +1,29 @@
+"""rustpde_mpi_tpu — a TPU-native spectral-method PDE framework.
+
+A from-scratch JAX/XLA rebuild with the capabilities of the Rust
+``rustpde-mpi`` framework (2-D Navier–Stokes / Rayleigh–Bénard convection with
+Chebyshev/Fourier spectral-Galerkin discretisation; serial, single-chip and
+mesh-sharded multi-chip execution).  See SURVEY.md for the component map.
+
+Public API vocabulary mirrors the reference (``/root/reference/src/lib.rs``):
+bases, Field2/Space2, solvers (Poisson/Hholtz/HholtzAdi), Navier2D models and
+an ``integrate`` driver — redesigned functionally for XLA: states are pytrees,
+steps are pure jitted functions, parallelism is `jax.sharding` over a Mesh.
+"""
+
+from . import config  # noqa: F401  (must import first: enables x64)
+from .bases import (  # noqa: F401
+    Base,
+    BaseKind,
+    Space2,
+    cheb_dirichlet,
+    cheb_dirichlet_neumann,
+    cheb_neumann,
+    chebyshev,
+    fourier_c2c,
+    fourier_r2c,
+)
+from .field import Field2, average, average_axis, norm_l2  # noqa: F401
+from .utils.integrate import Integrate, integrate  # noqa: F401
+
+__version__ = "0.1.0"

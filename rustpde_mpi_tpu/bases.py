@@ -1,0 +1,385 @@
+"""Spectral bases and 2-D tensor-product spaces.
+
+TPU-native rebuild of the basis layer the reference re-exports from the
+external ``funspace`` crate (/root/reference/src/bases.rs:11-19; full contract
+reconstructed in SURVEY.md S2.2).  Public vocabulary matches the reference:
+
+    chebyshev(n), cheb_dirichlet(n), cheb_neumann(n),
+    cheb_dirichlet_neumann(n), fourier_r2c(n), fourier_c2c(n), Space2
+
+Design (idiomatic JAX, not a port): every base precomputes small dense/banded
+operator matrices on the host in numpy f64 — stencil S (composite -> ortho),
+Galerkin projection P (ortho -> composite), coefficient-space derivatives,
+the Chebyshev quasi-inverse B2 — and the device work is FFTs/DCTs or batched
+matmuls over those constants.  No in-place mutation anywhere; fields are
+plain arrays.
+"""
+
+from __future__ import annotations
+
+import enum
+from functools import cached_property
+
+import jax.numpy as jnp
+import numpy as np
+
+from . import config
+from .ops import chebyshev as chb
+from .ops import fourier as fou
+from .ops import transforms as tr
+
+
+class BaseKind(enum.Enum):
+    CHEBYSHEV = "chebyshev"
+    CHEB_DIRICHLET = "cheb_dirichlet"
+    CHEB_NEUMANN = "cheb_neumann"
+    CHEB_DIRICHLET_NEUMANN = "cheb_dirichlet_neumann"
+    FOURIER_R2C = "fourier_r2c"
+    FOURIER_C2C = "fourier_c2c"
+
+    @property
+    def is_chebyshev(self) -> bool:
+        return self in (
+            BaseKind.CHEBYSHEV,
+            BaseKind.CHEB_DIRICHLET,
+            BaseKind.CHEB_NEUMANN,
+            BaseKind.CHEB_DIRICHLET_NEUMANN,
+        )
+
+    @property
+    def is_periodic(self) -> bool:
+        return self in (BaseKind.FOURIER_R2C, BaseKind.FOURIER_C2C)
+
+
+def _dev(mat: np.ndarray):
+    """Host f64 matrix -> device constant in the configured precision."""
+    if np.iscomplexobj(mat):
+        return jnp.asarray(mat.astype(config.complex_dtype()))
+    return jnp.asarray(mat.astype(config.real_dtype()))
+
+
+class Base:
+    """One spectral base along one axis.
+
+    ``n``: physical grid size; ``m``: number of spectral modes
+    (n-2 for composite Galerkin bases, n//2+1 for r2c, else n).
+    """
+
+    def __init__(self, kind: BaseKind, n: int):
+        self.kind = kind
+        self.n = n
+        self._diff_cache: dict = {}
+        self._grad_cache: dict = {}
+        self._grad_dev_cache: dict = {}
+        if kind in (BaseKind.CHEBYSHEV, BaseKind.FOURIER_C2C):
+            self.m = n
+        elif kind == BaseKind.FOURIER_R2C:
+            self.m = n // 2 + 1
+        else:
+            self.m = n - 2
+
+    def __repr__(self):
+        return f"Base({self.kind.value}, n={self.n})"
+
+    # -- grid ---------------------------------------------------------------
+
+    @cached_property
+    def points(self) -> np.ndarray:
+        if self.kind.is_chebyshev:
+            return chb.cgl_points(self.n)
+        return fou.fourier_points(self.n)
+
+    @property
+    def is_periodic(self) -> bool:
+        return self.kind.is_periodic
+
+    @property
+    def spectral_is_complex(self) -> bool:
+        return self.kind.is_periodic
+
+    # -- host operator matrices (funspace contract, SURVEY.md S2.2) ---------
+
+    @cached_property
+    def stencil(self) -> np.ndarray:
+        """S, (n x m): composite coefficients -> orthogonal coefficients."""
+        if self.kind == BaseKind.CHEBYSHEV:
+            return chb.stencil_chebyshev(self.n)
+        if self.kind == BaseKind.CHEB_DIRICHLET:
+            return chb.stencil_dirichlet(self.n)
+        if self.kind == BaseKind.CHEB_NEUMANN:
+            return chb.stencil_neumann(self.n)
+        if self.kind == BaseKind.CHEB_DIRICHLET_NEUMANN:
+            return chb.stencil_dirichlet_neumann(self.n)
+        return np.eye(self.m)
+
+    @cached_property
+    def projection(self) -> np.ndarray:
+        """P, (m x n): weighted Galerkin projection ortho -> composite
+        (funspace `from_ortho`)."""
+        if self.kind.is_chebyshev:
+            return chb.projection_matrix(self.stencil)
+        return np.eye(self.m)
+
+    @cached_property
+    def wavenumbers(self) -> np.ndarray:
+        if self.kind == BaseKind.FOURIER_R2C:
+            return fou.wavenumbers_r2c(self.n)
+        if self.kind == BaseKind.FOURIER_C2C:
+            return fou.wavenumbers_c2c(self.n)
+        raise ValueError("wavenumbers only defined for Fourier bases")
+
+    def diff_ortho(self, order: int) -> np.ndarray:
+        """Derivative operator in the *orthogonal* coefficient space.
+
+        Chebyshev: dense (n x n) upper-triangular recurrence matrix.
+        Fourier: returned as a diagonal (1-D array) of (i k)^order.
+        """
+        if order not in self._diff_cache:
+            if self.kind.is_chebyshev:
+                self._diff_cache[order] = chb.diff_matrix(self.n, order)
+            else:
+                self._diff_cache[order] = fou.diff_diag(
+                    self.wavenumbers, order, self.n, self.kind == BaseKind.FOURIER_R2C
+                )
+        return self._diff_cache[order]
+
+    def gradient_matrix(self, order: int) -> np.ndarray:
+        """D^order @ S: composite coefficients -> ortho derivative coeffs.
+
+        For Fourier bases this is diagonal and returned 1-D.
+        """
+        if order not in self._grad_cache:
+            if self.kind.is_chebyshev:
+                self._grad_cache[order] = self.diff_ortho(order) @ self.stencil
+            else:
+                self._grad_cache[order] = self.diff_ortho(order)
+        return self._grad_cache[order]
+
+    # funspace operator-matrix contract used by the solver layer
+    # (/root/reference/src/field.rs:195-249)
+
+    def mass(self) -> np.ndarray:
+        """The stencil S (identity for orthogonal/Fourier bases)."""
+        return self.stencil
+
+    def laplace(self) -> np.ndarray:
+        """D2 in ortho coefficient space (dense for Chebyshev, diag for Fourier)."""
+        if self.kind.is_chebyshev:
+            return self.diff_ortho(2)
+        return np.diag(-(self.wavenumbers**2))
+
+    def laplace_inv(self) -> np.ndarray:
+        """Chebyshev quasi-inverse B2 of D2 (rows 0,1 zero)."""
+        if not self.kind.is_chebyshev:
+            raise ValueError("laplace_inv only defined for Chebyshev bases")
+        return chb.quasi_inverse_b2(self.n)
+
+    def laplace_inv_eye(self) -> np.ndarray:
+        """(n-2) x n restriction selecting rows 2.. (B2 @ D2 restricted = I)."""
+        if not self.kind.is_chebyshev:
+            raise ValueError("laplace_inv_eye only defined for Chebyshev bases")
+        return chb.restricted_eye(self.n)
+
+    # -- device transforms --------------------------------------------------
+
+    @cached_property
+    def _fwd_matrix(self):
+        if self.kind.is_chebyshev:
+            return _dev(self.projection @ chb.analysis_matrix(self.n))
+        raise ValueError("matmul transform only for Chebyshev bases")
+
+    @cached_property
+    def _bwd_matrix(self):
+        if self.kind.is_chebyshev:
+            return _dev(chb.synthesis_matrix(self.n) @ self.stencil)
+        raise ValueError("matmul transform only for Chebyshev bases")
+
+    @cached_property
+    def _stencil_dev(self):
+        return _dev(self.stencil)
+
+    @cached_property
+    def _proj_dev(self):
+        return _dev(self.projection)
+
+    @cached_property
+    def _synthesis_dev(self):
+        return _dev(chb.synthesis_matrix(self.n))
+
+    def _gradient_dev(self, order: int):
+        if order not in self._grad_dev_cache:
+            self._grad_dev_cache[order] = _dev(self.gradient_matrix(order))
+        return self._grad_dev_cache[order]
+
+    def forward(self, v, axis: int, method: str = "fft"):
+        """Physical -> (composite) spectral along ``axis``."""
+        if self.kind.is_chebyshev:
+            if method == "matmul":
+                return tr.apply_matrix(self._fwd_matrix, v, axis)
+            c = tr.cheb_forward_fft(v, axis)
+            return self.from_ortho(c, axis)
+        if self.kind == BaseKind.FOURIER_R2C:
+            return tr.fourier_r2c_forward_fft(v, axis)
+        return tr.fourier_c2c_forward_fft(v, axis)
+
+    def backward(self, vhat, axis: int, method: str = "fft"):
+        """(Composite) spectral -> physical along ``axis``."""
+        if self.kind.is_chebyshev:
+            if method == "matmul":
+                return tr.apply_matrix(self._bwd_matrix, vhat, axis)
+            return tr.cheb_backward_fft(self.to_ortho(vhat, axis), axis)
+        if self.kind == BaseKind.FOURIER_R2C:
+            return tr.fourier_r2c_backward_fft(vhat, axis, self.n)
+        return tr.fourier_c2c_backward_fft(vhat, axis, self.n)
+
+    def backward_ortho(self, c, axis: int, method: str = "fft"):
+        """Synthesize physical values from *orthogonal* coefficients along
+        ``axis`` (no composite cast — gradients already live in ortho space)."""
+        if self.kind.is_chebyshev:
+            if method == "matmul":
+                return tr.apply_matrix(self._synthesis_dev, c, axis)
+            return tr.cheb_backward_fft(c, axis)
+        if self.kind == BaseKind.FOURIER_R2C:
+            return tr.fourier_r2c_backward_fft(c, axis, self.n)
+        return tr.fourier_c2c_backward_fft(c, axis, self.n)
+
+    def to_ortho(self, vhat, axis: int):
+        if self.kind in (BaseKind.CHEBYSHEV, BaseKind.FOURIER_R2C, BaseKind.FOURIER_C2C):
+            return vhat
+        return tr.apply_matrix(self._stencil_dev, vhat, axis)
+
+    def from_ortho(self, c, axis: int):
+        if self.kind in (BaseKind.CHEBYSHEV, BaseKind.FOURIER_R2C, BaseKind.FOURIER_C2C):
+            return c
+        return tr.apply_matrix(self._proj_dev, c, axis)
+
+    def gradient(self, vhat, order: int, axis: int):
+        """Composite spectral -> ortho-space derivative coefficients."""
+        if order == 0:
+            return self.to_ortho(vhat, axis)
+        g = self._gradient_dev(order)
+        if self.kind.is_chebyshev:
+            return tr.apply_matrix(g, vhat, axis)
+        return tr.apply_diag(g, vhat, axis)
+
+
+def chebyshev(n: int) -> Base:
+    return Base(BaseKind.CHEBYSHEV, n)
+
+
+def cheb_dirichlet(n: int) -> Base:
+    return Base(BaseKind.CHEB_DIRICHLET, n)
+
+
+def cheb_neumann(n: int) -> Base:
+    return Base(BaseKind.CHEB_NEUMANN, n)
+
+
+def cheb_dirichlet_neumann(n: int) -> Base:
+    return Base(BaseKind.CHEB_DIRICHLET_NEUMANN, n)
+
+
+def fourier_r2c(n: int) -> Base:
+    return Base(BaseKind.FOURIER_R2C, n)
+
+
+def fourier_c2c(n: int) -> Base:
+    return Base(BaseKind.FOURIER_C2C, n)
+
+
+class Space2:
+    """Tensor product of two bases (axis 0 = x, axis 1 = y).
+
+    Equivalent of funspace's ``Space2`` as used by the reference field layer
+    (/root/reference/src/field.rs:59-129).  ``method`` picks the transform
+    execution path: "fft" or "matmul" (Chebyshev axes only), default
+    auto-selected: FFT everywhere except f64-on-TPU, where the emulated FFT
+    path is unavailable and dense MXU transforms are used instead.
+    """
+
+    def __init__(self, base_x: Base, base_y: Base, method: str | None = None):
+        if base_y.kind.is_periodic and not base_x.kind.is_periodic:
+            raise ValueError("periodic y-axis under non-periodic x is unsupported")
+        self.bases = (base_x, base_y)
+        if any(b.kind.is_periodic for b in self.bases) and not config.supports_complex():
+            raise NotImplementedError(
+                "Fourier axes need complex dtypes, which this TPU backend lacks; "
+                "the split re/im Fourier path is provided by the model layer "
+                "(models.navier periodic-on-TPU mode), not by Space2."
+            )
+        if method is None:
+            # TPU (axon): no FFT and no complex dtypes -> dense MXU transforms.
+            method = "matmul" if config.is_tpu_like() else "fft"
+        self.method = method
+
+    @property
+    def base_x(self) -> Base:
+        return self.bases[0]
+
+    @property
+    def base_y(self) -> Base:
+        return self.bases[1]
+
+    @property
+    def shape_physical(self) -> tuple[int, int]:
+        return (self.bases[0].n, self.bases[1].n)
+
+    @property
+    def shape_spectral(self) -> tuple[int, int]:
+        return (self.bases[0].m, self.bases[1].m)
+
+    @property
+    def spectral_is_complex(self) -> bool:
+        return any(b.spectral_is_complex for b in self.bases)
+
+    def spectral_dtype(self):
+        return config.complex_dtype() if self.spectral_is_complex else config.real_dtype()
+
+    def base_kind(self, axis: int) -> BaseKind:
+        return self.bases[axis].kind
+
+    def coords(self) -> list[np.ndarray]:
+        return [b.points for b in self.bases]
+
+    def ndarray_physical(self):
+        return jnp.zeros(self.shape_physical, dtype=config.real_dtype())
+
+    def ndarray_spectral(self):
+        return jnp.zeros(self.shape_spectral, dtype=self.spectral_dtype())
+
+    # -- transforms ---------------------------------------------------------
+
+    def forward(self, v):
+        """Physical (n_x, n_y) -> spectral (m_x, m_y)."""
+        out = self.bases[0].forward(v, 0, self.method)
+        return self.bases[1].forward(out, 1, self.method)
+
+    def backward(self, vhat):
+        """Spectral (m_x, m_y) -> physical (n_x, n_y)."""
+        out = self.bases[1].backward(vhat, 1, self.method)
+        return self.bases[0].backward(out, 0, self.method)
+
+    def backward_ortho(self, c):
+        """Physical values from orthogonal-space coefficients (the space the
+        reference's scratch ``field`` provides, /root/reference/src/navier_stokes/navier.rs:256)."""
+        out = self.bases[1].backward_ortho(c, 1, self.method)
+        return self.bases[0].backward_ortho(out, 0, self.method)
+
+    def to_ortho(self, vhat):
+        out = self.bases[0].to_ortho(vhat, 0)
+        return self.bases[1].to_ortho(out, 1)
+
+    def from_ortho(self, c):
+        out = self.bases[0].from_ortho(c, 0)
+        return self.bases[1].from_ortho(out, 1)
+
+    def gradient(self, vhat, deriv, scale=None):
+        """d^deriv[0]/dx d^deriv[1]/dy in ortho space; divides by
+        scale^deriv like the reference (/root/reference/src/field.rs:127)."""
+        out = self.bases[0].gradient(vhat, deriv[0], 0)
+        out = self.bases[1].gradient(out, deriv[1], 1)
+        if scale is not None:
+            factor = (scale[0] ** deriv[0]) * (scale[1] ** deriv[1])
+            if factor != 1.0:
+                out = out / factor
+        return out

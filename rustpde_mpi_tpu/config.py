@@ -1,0 +1,56 @@
+"""Global configuration for rustpde_mpi_tpu.
+
+The reference framework (rustpde-mpi, /root/reference/src/lib.rs) computes in
+f64 everywhere.  On TPU, f64 is emulated and slow, so precision is a run-time
+choice here:
+
+* ``RUSTPDE_X64=1`` (default) enables ``jax_enable_x64`` at import time and all
+  operators/states default to float64 — required for the 1e-6 Nusselt-parity
+  gate against the CPU reference.
+* ``RUSTPDE_X64=0`` leaves JAX in f32 mode for maximum TPU throughput; solver
+  setup (eigendecompositions, LU factorizations) still happens on the host in
+  numpy f64 and is rounded once at the end.
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+import numpy as np
+
+X64: bool = os.environ.get("RUSTPDE_X64", "1") != "0"
+
+if X64:
+    jax.config.update("jax_enable_x64", True)
+
+# Spectral transforms/solves are precision-critical: TPU f32 matmuls default
+# to bf16 MXU passes (~1e-2 relative error), which destroys spectral accuracy.
+# "highest" keeps true f32 (or f64 under x64) accumulation.
+jax.config.update("jax_default_matmul_precision", "highest")
+
+
+def real_dtype():
+    """Default real dtype for device arrays."""
+    return np.float64 if X64 else np.float32
+
+
+def complex_dtype():
+    """Default complex dtype for device arrays."""
+    return np.complex128 if X64 else np.complex64
+
+
+def default_device_kind() -> str:
+    return jax.devices()[0].platform
+
+
+def is_tpu_like() -> bool:
+    """True on TPU (including the 'axon' tunnel platform)."""
+    return default_device_kind() not in ("cpu", "gpu", "cuda", "rocm")
+
+
+def supports_complex() -> bool:
+    """The axon TPU backend implements no complex dtypes (and therefore no
+    FFT); spectral pipelines there must run real-valued matmul transforms,
+    with Fourier axes in a split re/im representation."""
+    return not is_tpu_like()

@@ -1,0 +1,114 @@
+"""Field layer: data + transforms + solver-ingredient assembly.
+
+TPU rebuild of the reference field layer (/root/reference/src/field.rs).
+Unlike the reference's mutable ``FieldBase`` (v / vhat kept in sync by hand),
+the JAX-native design treats the spectral coefficients ``vhat`` as the single
+source of truth; physical values are computed on demand.  ``Field2`` is a
+thin user-facing convenience — the jitted model step functions operate on raw
+arrays.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from . import config
+from .bases import Base, BaseKind, Space2
+
+
+def grid_deltas(x: np.ndarray, periodic: bool) -> np.ndarray:
+    """Midpoint cell widths used for volumetric averages
+    (/root/reference/src/field.rs:135-163)."""
+    if periodic:
+        return np.full(x.shape, x[2] - x[1])
+    xs_left = np.concatenate([[x[0]], 0.5 * (x[1:] + x[:-1])])
+    xs_right = np.concatenate([0.5 * (x[1:] + x[:-1]), [x[-1]]])
+    return xs_right - xs_left
+
+
+class Field2:
+    """Two-dimensional field on a :class:`Space2`.
+
+    Attributes mirror the reference vocabulary: ``v`` (physical), ``vhat``
+    (spectral), ``x`` (coords), ``dx`` (grid deltas).  ``scale`` stretches
+    the coordinates only — spectral operators receive scale explicitly, as in
+    the reference (/root/reference/src/field.rs:93-100).
+    """
+
+    def __init__(self, space: Space2):
+        self.space = space
+        self.vhat = space.ndarray_spectral()
+        self.x = [b.points.copy() for b in space.bases]
+        self.dx = [
+            grid_deltas(b.points, b.is_periodic) for b in space.bases
+        ]
+
+    def scale(self, scale):
+        for i, s in enumerate(scale):
+            self.x[i] = self.x[i] * s
+            self.dx[i] = self.dx[i] * s
+
+    # -- transforms ---------------------------------------------------------
+
+    @property
+    def v(self):
+        return self.space.backward(self.vhat)
+
+    @v.setter
+    def v(self, values):
+        # physical dtype is complex only for c2c x-bases
+        dtype = (
+            config.complex_dtype()
+            if self.space.base_x.kind == BaseKind.FOURIER_C2C
+            else config.real_dtype()
+        )
+        self.vhat = self.space.forward(jnp.asarray(values, dtype=dtype))
+
+    def forward(self, v):
+        self.vhat = self.space.forward(v)
+
+    def backward(self):
+        return self.space.backward(self.vhat)
+
+    def to_ortho(self):
+        return self.space.to_ortho(self.vhat)
+
+    def from_ortho(self, c):
+        self.vhat = self.space.from_ortho(c)
+
+    def gradient(self, deriv, scale=None):
+        return self.space.gradient(self.vhat, deriv, scale)
+
+    # -- averages (volume-weighted, /root/reference/src/field/average.rs) ---
+
+    def average_axis(self, axis: int):
+        return average_axis(self.v, self.x, self.dx, axis)
+
+    def average(self):
+        return average(self.v, self.x, self.dx)
+
+
+def average_axis(v, x, dx, axis: int):
+    """Volume-weighted average along ``axis`` (trapezoid-like dx weights)."""
+    length = abs(float(x[axis][-1] - x[axis][0]))
+    w = jnp.asarray(dx[axis] / length, dtype=v.dtype)
+    shape = [1, 1]
+    shape[axis] = w.shape[0]
+    return jnp.sum(v * w.reshape(shape), axis=axis)
+
+
+def average(v, x, dx):
+    """Full volume-weighted average."""
+    ax = average_axis(v, x, dx, 0)
+    length = abs(float(x[1][-1] - x[1][0]))
+    w = jnp.asarray(dx[1] / length, dtype=v.dtype)
+    return jnp.sum(ax * w)
+
+
+def norm_l2(a) -> jnp.ndarray:
+    """Frobenius norm matching the reference's norm_l2_f64/c64
+    (/root/reference/src/navier_stokes/functions.rs:24-35)."""
+    if jnp.iscomplexobj(a):
+        return jnp.sqrt(jnp.sum(a.real**2 + a.imag**2))
+    return jnp.sqrt(jnp.sum(a**2))

@@ -1,0 +1,44 @@
+"""Host-side operator builders for Fourier bases (r2c and c2c).
+
+TPU rebuild of funspace's ``fourier_r2c`` / ``fourier_c2c`` (SURVEY.md S2.2).
+Domain convention: x in [0, 2*pi), uniform points, integer wavenumbers.  The
+physical aspect ratio enters exactly as in the reference — through the
+``scale`` argument of gradients/solvers, never through the base itself
+(/root/reference/src/navier_stokes/navier.rs:225).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def fourier_points(n: int) -> np.ndarray:
+    """Uniform grid on [0, 2*pi)."""
+    return 2.0 * np.pi * np.arange(n) / n
+
+
+def wavenumbers_r2c(n: int) -> np.ndarray:
+    """k = 0..n//2 (real-to-complex half spectrum)."""
+    return np.arange(n // 2 + 1, dtype=np.float64)
+
+
+def wavenumbers_c2c(n: int) -> np.ndarray:
+    """Standard FFT ordering 0, 1, ..., -1."""
+    return np.fft.fftfreq(n, d=1.0 / n)
+
+
+def diff_diag(k: np.ndarray, order: int, n: int, r2c: bool) -> np.ndarray:
+    """Diagonal of (d/dx)^order in spectral space: (i k)^order.
+
+    The Nyquist mode of an even-length r2c (or c2c) transform cannot represent
+    odd derivatives of a real signal; it is zeroed for odd orders (standard
+    practice; keeps gradients of real fields real-representable).
+    """
+    d = (1j * k) ** order
+    if order % 2 == 1 and n % 2 == 0:
+        d = d.copy()
+        if r2c:
+            d[-1] = 0.0
+        else:
+            d[n // 2] = 0.0
+    return d

@@ -1,0 +1,132 @@
+"""Device-side transform kernels (jax.numpy).
+
+Two interchangeable execution paths for every transform, selected per-space:
+
+* ``"fft"``  — FFT-based (XLA FFT).  O(n log n); the natural choice on CPU
+  and for f32 TPU runs.
+* ``"matmul"`` — dense transform matrices on the MXU.  O(n^2) flops but
+  MXU-batched; competitive on TPU and exact in emulated f64 where the TPU
+  FFT path is unavailable.
+
+The Chebyshev transform is a DCT-I realised through an even extension +
+rfft — the same mathematical object rustdct provides to the reference's
+funspace dependency (SURVEY.md S2.2), rebuilt here on XLA.
+All functions are shape-polymorphic over leading/trailing batch dims and
+operate along ``axis``.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def _move(a, axis):
+    return jnp.moveaxis(a, axis, -1)
+
+
+def _unmove(a, axis):
+    return jnp.moveaxis(a, -1, axis)
+
+
+# ----------------------------------------------------------------------------
+# DCT-I (Chebyshev at ascending CGL points)
+# ----------------------------------------------------------------------------
+
+
+def _dct1_real(u):
+    """DCT-I along last axis of a real array: returns c with
+    u_j = sum_k c_k cos(pi j k / N), N = n-1."""
+    n = u.shape[-1]
+    N = n - 1
+    ext = jnp.concatenate([u, u[..., -2:0:-1]], axis=-1)  # even extension, len 2N
+    R = jnp.fft.rfft(ext, axis=-1).real  # length N+1
+    sigma = np.full(n, 1.0 / N)
+    sigma[0] = sigma[-1] = 1.0 / (2.0 * N)
+    return R * jnp.asarray(sigma, dtype=R.dtype)
+
+
+def _idct1_real(c):
+    """Inverse of :func:`_dct1_real` (synthesis) along last axis."""
+    n = c.shape[-1]
+    N = n - 1
+    H = c * jnp.asarray(
+        np.concatenate([[2.0 * N], np.full(n - 2, float(N)), [2.0 * N]]),
+        dtype=c.dtype,
+    )
+    v = jnp.fft.irfft(H.astype(jnp.complex128 if c.dtype == jnp.float64 else jnp.complex64), n=2 * N, axis=-1)
+    return v[..., :n]
+
+
+def _complex_map(fn, a):
+    if jnp.iscomplexobj(a):
+        return fn(a.real) + 1j * fn(a.imag)
+    return fn(a)
+
+
+def cheb_forward_fft(u, axis: int):
+    """Physical values at ascending CGL points -> Chebyshev coefficients."""
+    x = _move(u, axis)
+    c = _complex_map(_dct1_real, x)
+    n = x.shape[-1]
+    signs = jnp.asarray((-1.0) ** np.arange(n), dtype=c.real.dtype)
+    return _unmove(c * signs, axis)
+
+
+def cheb_backward_fft(uh, axis: int):
+    """Chebyshev coefficients -> physical values at ascending CGL points."""
+    x = _move(uh, axis)
+    n = x.shape[-1]
+    signs = jnp.asarray((-1.0) ** np.arange(n), dtype=x.real.dtype)
+    u = _complex_map(_idct1_real, x * signs)
+    return _unmove(u, axis)
+
+
+# ----------------------------------------------------------------------------
+# Fourier r2c / c2c
+# ----------------------------------------------------------------------------
+
+
+def fourier_r2c_forward_fft(u, axis: int):
+    x = _move(u, axis)
+    n = x.shape[-1]
+    return _unmove(jnp.fft.rfft(x, axis=-1) / n, axis)
+
+
+def fourier_r2c_backward_fft(uh, axis: int, n: int):
+    x = _move(uh, axis)
+    return _unmove(jnp.fft.irfft(x * n, n=n, axis=-1), axis)
+
+
+def fourier_c2c_forward_fft(u, axis: int):
+    x = _move(u, axis)
+    n = x.shape[-1]
+    return _unmove(jnp.fft.fft(x, axis=-1) / n, axis)
+
+
+def fourier_c2c_backward_fft(uh, axis: int, n: int):
+    x = _move(uh, axis)
+    return _unmove(jnp.fft.ifft(x * n, axis=-1), axis)
+
+
+# ----------------------------------------------------------------------------
+# matmul application (MXU path); mat is a host numpy or jnp constant
+# ----------------------------------------------------------------------------
+
+
+def apply_matrix(mat, a, axis: int):
+    """Apply ``mat`` along ``axis`` of ``a``: out[..., i, ...] = mat[i, j] a[..., j, ...]."""
+    mat = jnp.asarray(mat)
+    if jnp.iscomplexobj(a) and not jnp.iscomplexobj(mat):
+        mat = mat.astype(a.dtype)
+    moved = jnp.moveaxis(a, axis, 0)
+    out = jnp.tensordot(mat, moved, axes=([1], [0]))
+    return jnp.moveaxis(out, 0, axis)
+
+
+def apply_diag(d, a, axis: int):
+    """Multiply by a diagonal along ``axis``."""
+    d = jnp.asarray(d)
+    shape = [1] * a.ndim
+    shape[axis] = d.shape[0]
+    return a * d.reshape(shape)

@@ -1,0 +1,56 @@
+"""Time-integration driver.
+
+Rebuild of the reference's ``Integrate`` trait + ``integrate`` free function
+(/root/reference/src/lib.rs:167-219).  The loop semantics (save-window test,
+three stop criteria) are preserved; models may additionally advance many
+steps per host round-trip via ``lax.scan`` inside their ``update`` (the
+TPU-friendly path) — the driver only sees wall-clock-relevant boundaries.
+"""
+
+from __future__ import annotations
+
+MAX_TIMESTEP = 10_000_000
+
+
+class Integrate:
+    """Duck-typed protocol: update(), get_time(), get_dt(), callback(), exit()."""
+
+    def update(self) -> None:
+        raise NotImplementedError
+
+    def get_time(self) -> float:
+        raise NotImplementedError
+
+    def get_dt(self) -> float:
+        raise NotImplementedError
+
+    def callback(self) -> None:
+        pass
+
+    def exit(self) -> bool:
+        return False
+
+
+def integrate(pde, max_time: float, save_intervall: float | None = None) -> None:
+    """Advance ``pde`` until ``max_time``; invoke ``pde.callback()`` whenever
+    the time lands inside a half-dt window around a save interval."""
+    timestep = 0
+    eps_dt = pde.get_dt() * 1e-4
+    while True:
+        pde.update()
+        timestep += 1
+
+        if save_intervall is not None:
+            t, dt = pde.get_time(), pde.get_dt()
+            if (t % save_intervall) < dt / 2.0 or (t % save_intervall) > save_intervall - dt / 2.0:
+                pde.callback()
+
+        if pde.get_time() + eps_dt >= max_time:
+            print(f"time limit reached: {pde.get_time()}")
+            break
+        if timestep >= MAX_TIMESTEP:
+            print(f"timestep limit reached: {timestep}")
+            break
+        if pde.exit():
+            print("break criteria triggered")
+            break

@@ -1,0 +1,21 @@
+"""Test configuration: force an 8-device virtual CPU mesh BEFORE jax import.
+
+Mirrors the test strategy from SURVEY.md S4: kernel/MMS tests run on CPU in
+f64; sharded paths are validated on a virtual multi-device CPU mesh and
+compared bit-for-bit against the unsharded results.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"  # env presets the TPU platform; tests run on a virtual CPU mesh
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("RUSTPDE_X64", "1")
+
+# The container's sitecustomize registers the TPU plugin and forces
+# jax_platforms="axon,cpu" programmatically (overriding the env var), so we
+# must override it back after import.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,209 @@
+"""Basis-layer tests: transform round-trips, differentiation of known
+functions, Galerkin stencil identities, quasi-inverse identities.
+
+Models the reference's inline solver tests + doc-tests (SURVEY.md S4), plus
+the boundary conditions each composite base must satisfy by construction.
+"""
+
+import numpy as np
+import pytest
+
+import rustpde_mpi_tpu as rp
+from rustpde_mpi_tpu.ops import chebyshev as chb
+
+
+# ---------------------------------------------------------------------------
+# transforms
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("n", [8, 9, 33])
+@pytest.mark.parametrize("method", ["fft", "matmul"])
+def test_chebyshev_roundtrip(n, method):
+    base = rp.chebyshev(n)
+    rng = np.random.default_rng(0)
+    u = rng.standard_normal(n)
+    uh = base.forward(u, 0, method)
+    back = base.backward(uh, 0, method)
+    np.testing.assert_allclose(np.asarray(back), u, atol=1e-12)
+
+
+def test_chebyshev_fft_matches_matmul():
+    n = 17
+    base = rp.chebyshev(n)
+    rng = np.random.default_rng(1)
+    u = rng.standard_normal((n, 5))
+    a = np.asarray(base.forward(u, 0, "fft"))
+    b = np.asarray(base.forward(u, 0, "matmul"))
+    np.testing.assert_allclose(a, b, atol=1e-12)
+
+
+def test_chebyshev_coefficients_of_polynomial():
+    # u(x) = T_0 + 2 T_1 + 3 T_3  ->  exact coefficient recovery
+    n = 9
+    base = rp.chebyshev(n)
+    x = base.points
+    u = 1.0 + 2.0 * x + 3.0 * (4 * x**3 - 3 * x)
+    uh = np.asarray(base.forward(u, 0, "fft"))
+    expect = np.zeros(n)
+    expect[0], expect[1], expect[3] = 1.0, 2.0, 3.0
+    np.testing.assert_allclose(uh, expect, atol=1e-12)
+
+
+@pytest.mark.parametrize("n", [8, 16])
+def test_fourier_r2c_roundtrip(n):
+    base = rp.fourier_r2c(n)
+    rng = np.random.default_rng(2)
+    u = rng.standard_normal(n)
+    uh = base.forward(u, 0)
+    back = np.asarray(base.backward(uh, 0))
+    np.testing.assert_allclose(back, u, atol=1e-12)
+
+
+def test_fourier_c2c_roundtrip():
+    n = 12
+    base = rp.fourier_c2c(n)
+    rng = np.random.default_rng(3)
+    u = rng.standard_normal(n) + 1j * rng.standard_normal(n)
+    uh = base.forward(u, 0)
+    back = np.asarray(base.backward(uh, 0))
+    np.testing.assert_allclose(back, u, atol=1e-12)
+
+
+# ---------------------------------------------------------------------------
+# differentiation
+# ---------------------------------------------------------------------------
+
+
+def test_chebyshev_derivative_of_sin():
+    n = 32
+    base = rp.chebyshev(n)
+    x = base.points
+    u = np.sin(np.pi * x)
+    uh = base.forward(u, 0, "fft")
+    du = np.asarray(base.backward(base.gradient(uh, 1, 0), 0, "fft"))
+    np.testing.assert_allclose(du, np.pi * np.cos(np.pi * x), atol=1e-8)
+    d2u = np.asarray(base.backward(base.gradient(uh, 2, 0), 0, "fft"))
+    np.testing.assert_allclose(d2u, -np.pi**2 * np.sin(np.pi * x), atol=1e-6)
+
+
+def test_fourier_derivative_of_wave():
+    n = 32
+    base = rp.fourier_r2c(n)
+    x = base.points
+    u = np.cos(3 * x)
+    uh = base.forward(u, 0)
+    du = np.asarray(base.backward(base.gradient(uh, 1, 0), 0))
+    np.testing.assert_allclose(du, -3 * np.sin(3 * x), atol=1e-10)
+
+
+def test_space2_mixed_gradient_with_scale():
+    nx, ny = 32, 33
+    space = rp.Space2(rp.fourier_r2c(nx), rp.chebyshev(ny))
+    scale = [2.0, 1.0]
+    x = space.base_x.points * scale[0]
+    y = space.base_y.points
+    X, Y = np.meshgrid(x, y, indexing="ij")
+    u = np.cos(2 * X / scale[0]) * np.sin(np.pi * Y)
+    vhat = space.forward(u)
+    dudx = np.asarray(space.backward(space.gradient(vhat, [1, 0], scale)))
+    expect = -(2 / scale[0]) * np.sin(2 * X / scale[0]) * np.sin(np.pi * Y)
+    np.testing.assert_allclose(dudx, expect, atol=1e-8)
+
+
+# ---------------------------------------------------------------------------
+# composite bases: boundary conditions + ortho casts
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize(
+    "factory", [rp.cheb_dirichlet, rp.cheb_neumann, rp.cheb_dirichlet_neumann]
+)
+def test_composite_roundtrip_via_ortho(factory):
+    n = 16
+    base = factory(n)
+    rng = np.random.default_rng(4)
+    comp = rng.standard_normal(base.m)
+    ortho = base.to_ortho(comp, 0)
+    back = np.asarray(base.from_ortho(ortho, 0))
+    np.testing.assert_allclose(back, comp, atol=1e-10)
+
+
+def test_dirichlet_basis_satisfies_bc():
+    n = 12
+    S = rp.cheb_dirichlet(n).stencil
+    Tm1 = np.array([(-1.0) ** k for k in range(n)])  # T_k(-1)
+    Tp1 = np.ones(n)  # T_k(1)
+    np.testing.assert_allclose(Tm1 @ S, 0.0, atol=1e-12)
+    np.testing.assert_allclose(Tp1 @ S, 0.0, atol=1e-12)
+
+
+def test_neumann_basis_satisfies_bc():
+    n = 12
+    S = rp.cheb_neumann(n).stencil
+    dTm1 = np.array([(-1.0) ** (k + 1) * k**2 for k in range(n)])  # T_k'(-1)
+    dTp1 = np.array([float(k**2) for k in range(n)])  # T_k'(1)
+    np.testing.assert_allclose(dTm1 @ S, 0.0, atol=1e-12)
+    np.testing.assert_allclose(dTp1 @ S, 0.0, atol=1e-12)
+
+
+def test_dirichlet_neumann_basis_satisfies_bc():
+    n = 12
+    S = rp.cheb_dirichlet_neumann(n).stencil
+    Tm1 = np.array([(-1.0) ** k for k in range(n)])
+    dTp1 = np.array([float(k**2) for k in range(n)])
+    np.testing.assert_allclose(Tm1 @ S, 0.0, atol=1e-12)
+    np.testing.assert_allclose(dTp1 @ S, 0.0, atol=1e-12)
+
+
+def test_composite_forward_reproduces_bc_function():
+    # a function that already satisfies dirichlet BCs is reproduced exactly
+    n = 24
+    base = rp.cheb_dirichlet(n)
+    x = base.points
+    u = np.sin(np.pi * x)
+    uh = base.forward(u, 0, "fft")
+    back = np.asarray(base.backward(uh, 0, "fft"))
+    np.testing.assert_allclose(back, u, atol=1e-10)
+
+
+# ---------------------------------------------------------------------------
+# quasi-inverse identities (the contract the solver layer builds on)
+# ---------------------------------------------------------------------------
+
+
+def test_b2_is_quasi_inverse_of_d2():
+    n = 16
+    D2 = chb.diff_matrix(n, 2)
+    B2 = chb.quasi_inverse_b2(n)
+    prod = B2 @ D2
+    np.testing.assert_allclose(prod[2:, :], np.eye(n)[2:, :], atol=1e-10)
+    np.testing.assert_allclose(prod[:2, :], 0.0, atol=1e-12)
+
+
+def test_helmholtz_operator_is_banded():
+    # pinv @ S must be 4-banded with offsets (-2, 0, 2, 4) — the structure the
+    # reference's Fdma kernel exploits (/root/reference/src/solver/fdma.rs).
+    n = 16
+    base = rp.cheb_dirichlet(n)
+    S = base.mass()
+    pinv = base.laplace_inv_eye() @ base.laplace_inv()
+    A = pinv @ S
+    m = A.shape[0]
+    for i in range(m):
+        for j in range(m):
+            if j - i not in (-2, 0, 2, 4):
+                assert abs(A[i, j]) < 1e-12, (i, j, A[i, j])
+
+
+def test_dirichlet_neumann_operator_is_seven_banded():
+    n = 16
+    base = rp.cheb_dirichlet_neumann(n)
+    S = base.mass()
+    pinv = base.laplace_inv_eye() @ base.laplace_inv()
+    A = pinv @ S
+    m = A.shape[0]
+    for i in range(m):
+        for j in range(m):
+            if j - i not in (-2, -1, 0, 1, 2, 3, 4):
+                assert abs(A[i, j]) < 1e-12, (i, j, A[i, j])
