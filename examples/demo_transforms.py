@@ -7,6 +7,12 @@ as a runnable example.  Works on CPU (f64) and TPU (f32, set RUSTPDE_X64=0).
     JAX_PLATFORMS=cpu python examples/demo_transforms.py  # CPU f64
 """
 
+import sys
+
+import os
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 import numpy as np
 
 import jax

@@ -24,6 +24,7 @@ from .bases import (  # noqa: F401
     fourier_r2c,
 )
 from .field import Field2, average, average_axis, norm_l2  # noqa: F401
+from .models.navier import Navier2D, NavierState  # noqa: F401
 from .utils.integrate import Integrate, integrate  # noqa: F401
 
 __version__ = "0.1.0"

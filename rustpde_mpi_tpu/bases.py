@@ -52,10 +52,17 @@ class BaseKind(enum.Enum):
 
 
 def _dev(mat: np.ndarray):
-    """Host f64 matrix -> device constant in the configured precision."""
-    if np.iscomplexobj(mat):
-        return jnp.asarray(mat.astype(config.complex_dtype()))
-    return jnp.asarray(mat.astype(config.real_dtype()))
+    """Host f64 matrix -> device constant in the configured precision.
+
+    ``ensure_compile_time_eval`` keeps the constant concrete even when the
+    first (lazy) materialization happens inside a jit trace — otherwise the
+    cached value would be a leaked tracer."""
+    import jax
+
+    with jax.ensure_compile_time_eval():
+        if np.iscomplexobj(mat):
+            return jnp.asarray(mat.astype(config.complex_dtype()))
+        return jnp.asarray(mat.astype(config.real_dtype()))
 
 
 class Base:
@@ -303,9 +310,9 @@ class Space2:
         self.bases = (base_x, base_y)
         if any(b.kind.is_periodic for b in self.bases) and not config.supports_complex():
             raise NotImplementedError(
-                "Fourier axes need complex dtypes, which this TPU backend lacks; "
-                "the split re/im Fourier path is provided by the model layer "
-                "(models.navier periodic-on-TPU mode), not by Space2."
+                "Fourier axes need complex dtypes, which this TPU backend "
+                "lacks; use SplitSpace2 (split re/im representation) for "
+                "periodic configurations on TPU."
             )
         if method is None:
             # TPU (axon): no FFT and no complex dtypes -> dense MXU transforms.

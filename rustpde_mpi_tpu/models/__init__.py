@@ -1,0 +1,3 @@
+"""Physics model layer: Navier-Stokes DNS and derived solvers."""
+
+from .navier import Navier2D, NavierState  # noqa: F401

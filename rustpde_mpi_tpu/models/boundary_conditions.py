@@ -1,0 +1,68 @@
+"""Inhomogeneous-boundary-condition lift profiles.
+
+TPU rebuild of the reference's boundary-condition fields
+(/root/reference/src/navier_stokes/boundary_conditions.rs).  The reference
+returns mutable ``Field2`` objects; here each function returns the *physical
+values* of the lift profile on the grid as a plain numpy array — the model
+layer transforms them once at build time into orthogonal-space device
+constants (the lift-field trick: the BC-satisfying field lives in the full
+Chebyshev/Fourier space, the evolved remainder in the homogeneous Galerkin
+space).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def bc_rbc_values(x: np.ndarray, y: np.ndarray) -> np.ndarray:
+    """Rayleigh–Bénard temperature lift: T = +0.5 at the bottom plate,
+    -0.5 at the top (linear conduction profile,
+    /root/reference/src/navier_stokes/boundary_conditions.rs:18-36)."""
+    y1, y2 = 0.5, -0.5
+    x1, x2 = y[0], y[-1]
+    m = (y2 - y1) / (x2 - x1)
+    n = (y1 * x2 - y2 * x1) / (x2 - x1)
+    profile = m * y + n
+    return np.broadcast_to(profile[None, :], (x.shape[0], y.shape[0])).copy()
+
+
+def pres_bc_rbc_values(x: np.ndarray, y: np.ndarray) -> np.ndarray:
+    """Rayleigh–Bénard pressure lift: parabola a*y^2 + b*y whose derivative
+    matches the hydrostatic buoyancy +-0.5 at the plates
+    (/root/reference/src/navier_stokes/boundary_conditions.rs:40-70)."""
+    df_l, df_r = 0.5, -0.5
+    y_l, y_r = y[0], y[-1]
+    a = 0.5 * (df_r - df_l) / (y_r - y_l)
+    b = df_l - 2.0 * a * y_l
+    parabola = a * y**2 + b * y
+    return np.broadcast_to(parabola[None, :], (x.shape[0], y.shape[0])).copy()
+
+
+def bc_hc_values(x: np.ndarray, y: np.ndarray) -> np.ndarray:
+    """Horizontal-convection temperature lift: T = -0.5*cos(2 pi (x-x0)/L) at
+    the bottom, T = T' = 0 at the top — realised as a parabola in y with
+    vertex at the top wall (/root/reference/src/navier_stokes/boundary_conditions.rs:101-136)."""
+    x0 = x[0]
+    length = x[-1] - x[0]
+    f_x = -0.5 * np.cos(2.0 * np.pi * (x - x0) / length)  # bottom value per column
+    y_l, y_r = y[0], y[-1]
+    a = f_x / (y_l - y_r) ** 2  # parabola through (y_l, f_x) with vertex at y_r
+    return a[:, None] * (y[None, :] - y_r) ** 2
+
+
+def transfer_function(x: np.ndarray, v_l: float, v_m: float, v_r: float, k: float) -> np.ndarray:
+    """Smooth sidewall transition profile
+    (/root/reference/src/navier_stokes/boundary_conditions.rs:262-274)."""
+    length = x[-1] - x[0]
+    xs = x * 2.0 / length
+    neg = -1.0 * k * xs / (k + xs + 1.0) * (v_l - v_m) + v_m
+    pos = 1.0 * k * xs / (k - xs + 1.0) * (v_r - v_m) + v_m
+    return np.where(xs < 0.0, neg, pos)
+
+
+def bc_zero_values(x: np.ndarray, y: np.ndarray, k: float) -> np.ndarray:
+    """Zero-sidewall temperature lift with smooth transfer to +-0.5 plates
+    (/root/reference/src/navier_stokes/boundary_conditions.rs:80-94)."""
+    profile = transfer_function(y, 0.5, 0.0, -0.5, k)
+    return np.broadcast_to(profile[None, :], (x.shape[0], y.shape[0])).copy()

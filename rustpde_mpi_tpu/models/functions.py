@@ -1,0 +1,63 @@
+"""Physics helper functions for the Navier–Stokes models.
+
+TPU rebuild of /root/reference/src/navier_stokes/functions.rs — dimensionless
+groups, dealiasing masks and initial-condition constructors.  The observables
+(eval_nu/nuvol/re) live as jitted closures on the model in navier.py, since
+they close over spaces and average weights.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def get_nu(ra: float, pr: float, height: float) -> float:
+    """Viscosity from Ra, Pr and cell height: sqrt(Pr / (Ra/h^3))
+    (/root/reference/src/navier_stokes/functions.rs:12-15)."""
+    return float(np.sqrt(pr / (ra / height**3)))
+
+
+def get_ka(ra: float, pr: float, height: float) -> float:
+    """Diffusivity from Ra, Pr and cell height: sqrt(1 / (Ra/h^3 * Pr))
+    (/root/reference/src/navier_stokes/functions.rs:18-21)."""
+    return float(np.sqrt(1.0 / ((ra / height**3) * pr)))
+
+
+def dealias_mask(shape: tuple[int, int]) -> np.ndarray:
+    """2/3-rule dealiasing mask over the scratch field's spectral shape:
+    zero all modes with index >= 2/3 * m along either axis (matches the
+    reference's slice fills, /root/reference/src/navier_stokes/functions.rs:72-82,
+    including the slightly asymmetric cutoff for r2c axes whose mode count is
+    nx//2+1)."""
+    mask = np.ones(shape)
+    n_x = shape[0] * 2 // 3
+    n_y = shape[1] * 2 // 3
+    mask[n_x:, :] = 0.0
+    mask[:, n_y:] = 0.0
+    return mask
+
+
+def _normalized_coords(x: np.ndarray) -> np.ndarray:
+    return (x - x[0]) / (x[-1] - x[0])
+
+
+def sin_cos_values(x: np.ndarray, y: np.ndarray, amp: float, m: float, n: float) -> np.ndarray:
+    """amp * sin(pi m x~) cos(pi n y~) on normalized coordinates
+    (/root/reference/src/navier_stokes/functions.rs:85-104)."""
+    xn = _normalized_coords(x)
+    yn = _normalized_coords(y)
+    return amp * np.sin(np.pi * m * xn)[:, None] * np.cos(np.pi * n * yn)[None, :]
+
+
+def cos_sin_values(x: np.ndarray, y: np.ndarray, amp: float, m: float, n: float) -> np.ndarray:
+    """amp * cos(pi m x~) sin(pi n y~) on normalized coordinates
+    (/root/reference/src/navier_stokes/functions.rs:106-126)."""
+    xn = _normalized_coords(x)
+    yn = _normalized_coords(y)
+    return amp * np.cos(np.pi * m * xn)[:, None] * np.sin(np.pi * n * yn)[None, :]
+
+
+def random_values(shape: tuple[int, int], amp: float, rng: np.random.Generator) -> np.ndarray:
+    """Uniform disturbance in [-amp, amp]
+    (/root/reference/src/navier_stokes/functions.rs:128-140)."""
+    return rng.uniform(-amp, amp, size=shape)

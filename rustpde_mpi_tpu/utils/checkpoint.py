@@ -1,0 +1,123 @@
+"""HDF5 checkpoint/restart with the reference's snapshot layout.
+
+Rebuild of /root/reference/src/navier_stokes/navier_io.rs + src/field/io.rs +
+src/io/read_write_hdf5.rs:
+
+* per-variable groups ``{var}/{x,dx,y,dy,v,vhat}`` with variables named
+  ``ux, uy, temp, pres`` (+ ``tempbc``); complex spectral data stored as
+  ``vhat_re``/``vhat_im`` dataset pairs
+  (/root/reference/src/io/read_write_hdf5.rs:171-188),
+* scalars ``time`` + physics params at the file root,
+* restart restores spectral coefficients, supporting **resolution change via
+  spectral truncation/zero-padding with Fourier renormalization**
+  (/root/reference/src/field/io.rs:151-176).
+
+One deliberate fix over the reference: the reference writes the coordinate
+array into both the ``x`` and ``dx`` datasets (field/io.rs:96-99); here ``dx``
+holds the actual grid deltas.  Readers that only consume ``x``/``y``/``v``
+(the plot/ scripts, xmf generator) see identical layout.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from ..bases import BaseKind, Space2
+from ..field import grid_deltas
+
+_VARS = (("ux", "velx"), ("uy", "vely"), ("temp", "temp"), ("pres", "pres"))
+
+
+def _write_array(group, name: str, data: np.ndarray) -> None:
+    if np.iscomplexobj(data):
+        _write_array(group, f"{name}_re", np.ascontiguousarray(data.real))
+        _write_array(group, f"{name}_im", np.ascontiguousarray(data.imag))
+        return
+    if name in group:
+        del group[name]
+    group.create_dataset(name, data=np.asarray(data, dtype=np.float64))
+
+
+def _read_array(group, name: str, is_complex: bool) -> np.ndarray:
+    if is_complex:
+        return np.asarray(group[f"{name}_re"]) + 1j * np.asarray(group[f"{name}_im"])
+    return np.asarray(group[name])
+
+
+def interpolate_2d(old: np.ndarray, new_shape: tuple[int, int], kind_x: BaseKind) -> np.ndarray:
+    """Spectral interpolation on resolution change: truncate / zero-pad the
+    coefficient array, with r2c renormalization on axis 0
+    (/root/reference/src/field/io.rs:151-176)."""
+    new = np.zeros(new_shape, dtype=old.dtype)
+    s0 = min(old.shape[0], new_shape[0])
+    s1 = min(old.shape[1], new_shape[1])
+    new[:s0, :s1] = old[:s0, :s1]
+    if kind_x == BaseKind.FOURIER_R2C:
+        new *= (new_shape[0] - 1) / (old.shape[0] - 1)
+    return new
+
+
+def write_field(h5, varname: str, space: Space2, vhat, x, dx) -> None:
+    """Write one field group in the reference layout."""
+    grp = h5.require_group(varname)
+    _write_array(grp, "x", x[0])
+    _write_array(grp, "dx", dx[0])
+    _write_array(grp, "y", x[1])
+    _write_array(grp, "dy", dx[1])
+    _write_array(grp, "v", np.asarray(space.backward(vhat)))
+    _write_array(grp, "vhat", np.asarray(vhat))
+
+
+def read_field_vhat(h5, varname: str, space: Space2) -> np.ndarray:
+    """Read one field's spectral coefficients, interpolating on mismatch."""
+    grp = h5[varname]
+    data = _read_array(grp, "vhat", space.spectral_is_complex)
+    if data.shape != space.shape_spectral:
+        data = interpolate_2d(data, space.shape_spectral, space.base_kind(0))
+    return data
+
+
+def _model_coords(model):
+    xs = model.x  # scaled coords the model already derived
+    dxs = [
+        grid_deltas(b.points, b.is_periodic) * s
+        for b, s in zip(model.field_space.bases, model.scale)
+    ]
+    return xs, dxs
+
+
+def write_snapshot(model, filename: str) -> None:
+    """Write a flow snapshot (/root/reference/src/navier_stokes/navier_io.rs:44-62)."""
+    import h5py
+
+    os.makedirs(os.path.dirname(filename) or ".", exist_ok=True)
+    xs, dxs = _model_coords(model)
+    with h5py.File(filename, "w") as h5:
+        for varname, attr in _VARS:
+            space = getattr(model, f"{attr}_space")
+            write_field(h5, varname, space, getattr(model.state, attr), xs, dxs)
+        if getattr(model, "tempbc_ortho", None) is not None:
+            write_field(h5, "tempbc", model.field_space, model.tempbc_ortho, xs, dxs)
+        h5.create_dataset("time", data=float(model.time))
+        for key, value in model.params.items():
+            h5.create_dataset(key, data=float(value))
+
+
+def read_snapshot(model, filename: str) -> None:
+    """Restore a flow snapshot: spectral coefficients + time
+    (/root/reference/src/navier_stokes/navier_io.rs:21-29)."""
+    import h5py
+
+    import jax.numpy as jnp
+
+    with h5py.File(filename, "r") as h5:
+        updates = {}
+        for varname, attr in _VARS:
+            space = getattr(model, f"{attr}_space")
+            vhat = read_field_vhat(h5, varname, space)
+            updates[attr] = jnp.asarray(vhat, dtype=space.spectral_dtype())
+        model.state = model.state._replace(**updates)
+        model.time = float(np.asarray(h5["time"]))
+    print(f" <== {filename}")

@@ -33,7 +33,16 @@ class Integrate:
 
 def integrate(pde, max_time: float, save_intervall: float | None = None) -> None:
     """Advance ``pde`` until ``max_time``; invoke ``pde.callback()`` whenever
-    the time lands inside a half-dt window around a save interval."""
+    the time lands inside a half-dt window around a save interval.
+
+    Models exposing ``update_n`` (the jitted ``lax.scan`` fast path) advance
+    whole save intervals per device dispatch — essential on TPU where every
+    dispatch crosses a host relay.  Stop criteria are then evaluated at
+    interval boundaries instead of every step (same observable behavior: the
+    reference only *acts* on them via prints/saves at those boundaries)."""
+    if hasattr(pde, "update_n"):
+        _integrate_chunked(pde, max_time, save_intervall)
+        return
     timestep = 0
     eps_dt = pde.get_dt() * 1e-4
     while True:
@@ -54,3 +63,41 @@ def integrate(pde, max_time: float, save_intervall: float | None = None) -> None
         if pde.exit():
             print("break criteria triggered")
             break
+
+
+def _integrate_chunked(pde, max_time: float, save_intervall: float | None) -> None:
+    """Chunked driver: one ``update_n`` dispatch per save interval.
+
+    Each chunk aims at the next *absolute* save boundary (k * save_intervall)
+    so callback times never drift, and the callback only fires when the time
+    actually lands in the reference's half-dt save window."""
+    dt = pde.get_dt()
+    eps_dt = dt * 1e-4
+    timestep = 0
+    while pde.get_time() + eps_dt < max_time:
+        t = pde.get_time()
+        if save_intervall is not None:
+            # next boundary strictly after t (half-dt tolerance so a chunk
+            # that just landed on a boundary targets the following one)
+            import math
+
+            k_next = math.floor((t + dt / 2.0) / save_intervall) + 1
+            target = min(k_next * save_intervall, max_time)
+        else:
+            target = max_time
+        n = max(1, round((target - t) / dt))
+        n = min(n, MAX_TIMESTEP - timestep)
+        pde.update_n(n)
+        timestep += n
+        if save_intervall is not None:
+            t_new = pde.get_time()
+            rem = t_new % save_intervall
+            if rem < dt / 2.0 or rem > save_intervall - dt / 2.0:
+                pde.callback()
+        if timestep >= MAX_TIMESTEP:
+            print(f"timestep limit reached: {timestep}")
+            return
+        if pde.exit():
+            print("break criteria triggered")
+            return
+    print(f"time limit reached: {pde.get_time()}")

@@ -1,0 +1,59 @@
+"""Callback-side IO for the Navier models: snapshots, diagnostics, info.txt.
+
+Rebuild of /root/reference/src/navier_stokes/navier_io.rs:84-149: write the
+flow HDF5 snapshot (optionally throttled by ``write_intervall``), update and
+persist statistics, print time / |div| / Nu / Nuvol / Re, and append a
+``time nu nuvol re`` row to data/info.txt.
+"""
+
+from __future__ import annotations
+
+import os
+
+from . import checkpoint
+
+
+def callback(
+    model,
+    flowname: str | None = None,
+    io_name: str = "data/info.txt",
+    suppress_io: bool = False,
+) -> None:
+    t = model.get_time()
+    dt = model.get_dt()
+    os.makedirs("data", exist_ok=True)
+
+    # flow snapshot, throttled by write_intervall like the reference
+    # (navier_io.rs:96-103)
+    if flowname is None:
+        flowname = f"data/flow{t:08.2f}.h5"
+    write_intervall = getattr(model, "write_intervall", None)
+    if write_intervall is None or (t + dt / 2.0) % write_intervall < dt:
+        try:
+            checkpoint.write_snapshot(model, flowname)
+        except OSError as exc:  # never fatal, matching the reference
+            print(f"unable to write {flowname}: {exc}")
+
+    # statistics (navier_io.rs:105-121)
+    stats = getattr(model, "statistics", None)
+    if stats is not None:
+        if (t + dt / 2.0) % stats.save_stat < dt:
+            stats.update(model)
+        if (t + dt / 2.0) % stats.write_stat < dt:
+            try:
+                stats.write("data/statistics.h5")
+            except OSError as exc:
+                print(f"unable to write statistics: {exc}")
+
+    if suppress_io:
+        return
+    nu, nuvol, re, div = model.get_observables()
+    print(
+        f"time = {t:9.3f}      |div| = {div:4.2e}      "
+        f"Nu = {nu:5.3e}      Nuv = {nuvol:5.3e}      Re = {re:5.3e}"
+    )
+    try:
+        with open(io_name, "a", encoding="utf-8") as fh:
+            fh.write(f"{t} {nu} {nuvol} {re}\n")
+    except OSError as exc:
+        print(f"unable to write {io_name}: {exc}")

@@ -1,0 +1,84 @@
+"""Checkpoint/restart tests: reference HDF5 layout, round-trip, and
+resolution-change restart via spectral interpolation (SURVEY.md S3.5)."""
+
+import numpy as np
+import pytest
+
+from rustpde_mpi_tpu import Navier2D
+
+h5py = pytest.importorskip("h5py")
+
+
+def _run_model(nx=17, ny=17, periodic=False):
+    model = Navier2D(nx, ny, 1e4, 1.0, 0.01, 1.0, "rbc", periodic=periodic)
+    model.set_velocity(0.1, 1.0, 1.0)
+    model.set_temperature(0.1, 1.0, 1.0)
+    model.update_n(10)
+    return model
+
+
+def test_snapshot_layout(tmp_path):
+    model = _run_model()
+    fname = str(tmp_path / "flow.h5")
+    model.write(fname)
+    with h5py.File(fname, "r") as h5:
+        for var in ("ux", "uy", "temp", "pres", "tempbc"):
+            for ds in ("x", "dx", "y", "dy", "v", "vhat"):
+                assert f"{var}/{ds}" in h5, f"missing {var}/{ds}"
+        assert float(np.asarray(h5["time"])) == pytest.approx(0.1)
+        for key in ("ra", "pr", "nu", "ka"):
+            assert key in h5
+
+
+def test_roundtrip_restores_state(tmp_path):
+    model = _run_model()
+    fname = str(tmp_path / "flow.h5")
+    model.write(fname)
+
+    other = Navier2D(17, 17, 1e4, 1.0, 0.01, 1.0, "rbc", periodic=False)
+    other.read(fname)
+    assert other.time == pytest.approx(model.time)
+    for attr in ("temp", "velx", "vely", "pres"):
+        np.testing.assert_allclose(
+            np.asarray(getattr(other.state, attr)),
+            np.asarray(getattr(model.state, attr)),
+            atol=1e-14,
+        )
+    # restart continues identically
+    model.update_n(5)
+    other.update_n(5)
+    np.testing.assert_allclose(
+        np.asarray(other.state.temp), np.asarray(model.state.temp), atol=1e-13
+    )
+
+
+def test_restart_with_resolution_change(tmp_path):
+    model = _run_model(nx=17, ny=17)
+    fname = str(tmp_path / "flow.h5")
+    model.write(fname)
+
+    finer = Navier2D(25, 25, 1e4, 1.0, 0.01, 1.0, "rbc", periodic=False)
+    finer.read(fname)
+    # zero-padded spectral restart: coefficient prefix is exact, tail is zero
+    old = np.asarray(model.state.temp)
+    new = np.asarray(finer.state.temp)
+    np.testing.assert_allclose(new[: old.shape[0], : old.shape[1]], old, atol=1e-14)
+    assert np.abs(new[old.shape[0] :, :]).max() == 0.0
+    # Nu agrees up to the quadrature difference between the two grids
+    assert finer.eval_nu() == pytest.approx(model.eval_nu(), rel=1e-2)
+    finer.update_n(5)
+    assert np.all(np.isfinite(np.asarray(finer.state.temp)))
+
+
+def test_periodic_roundtrip(tmp_path):
+    model = _run_model(nx=16, ny=17, periodic=True)
+    fname = str(tmp_path / "flow.h5")
+    model.write(fname)
+    with h5py.File(fname, "r") as h5:
+        assert "temp/vhat_re" in h5 and "temp/vhat_im" in h5
+
+    other = Navier2D(16, 17, 1e4, 1.0, 0.01, 1.0, "rbc", periodic=True)
+    other.read(fname)
+    np.testing.assert_allclose(
+        np.asarray(other.state.temp), np.asarray(model.state.temp), atol=1e-14
+    )
