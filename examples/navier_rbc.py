@@ -22,6 +22,11 @@ def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--quick", action="store_true", help="small fast config")
     ap.add_argument("--periodic", action="store_true")
+    ap.add_argument(
+        "--mesh",
+        action="store_true",
+        help="pencil-shard over all visible devices (jax.sharding Mesh)",
+    )
     ap.add_argument("--nx", type=int, default=None)
     ap.add_argument("--ny", type=int, default=None)
     ap.add_argument("--ra", type=float, default=None)
@@ -39,8 +44,14 @@ def main() -> int:
     dt = args.dt or dt
     max_time = args.max_time or max_time
 
+    mesh = None
+    if args.mesh:
+        from rustpde_mpi_tpu.parallel import make_mesh
+
+        mesh = make_mesh()
+        print(f"pencil mesh over {mesh.size} devices")
     ctor = Navier2D.new_periodic if args.periodic else Navier2D.new_confined
-    navier = ctor(nx, ny, ra, 1.0, dt, 1.0, "rbc")
+    navier = ctor(nx, ny, ra, 1.0, dt, 1.0, "rbc", mesh=mesh)
 
     t0 = time.perf_counter()
     navier.callback()

@@ -355,22 +355,38 @@ class Space2:
         return jnp.zeros(self.shape_spectral, dtype=self.spectral_dtype())
 
     # -- transforms ---------------------------------------------------------
+    #
+    # Pencil discipline (active only under a parallel mesh): physical data is
+    # a y-pencil (axis 0 sharded), spectral an x-pencil (axis 1 sharded); each
+    # 2-D transform works on its local axis, flips pencils in between —
+    # exactly funspace's forward_inplace_mpi = [transform y][transpose y->x]
+    # [transform x] (/root/reference/src/field_mpi.rs:324-333), with the
+    # all-to-all left to XLA GSPMD.
 
     def forward(self, v):
         """Physical (n_x, n_y) -> spectral (m_x, m_y)."""
-        out = self.bases[0].forward(v, 0, self.method)
-        return self.bases[1].forward(out, 1, self.method)
+        from .parallel.mesh import PHYS, SPEC, constrain
+
+        out = self.bases[1].forward(constrain(v, PHYS), 1, self.method)
+        out = self.bases[0].forward(constrain(out, SPEC), 0, self.method)
+        return constrain(out, SPEC)
 
     def backward(self, vhat):
         """Spectral (m_x, m_y) -> physical (n_x, n_y)."""
-        out = self.bases[1].backward(vhat, 1, self.method)
-        return self.bases[0].backward(out, 0, self.method)
+        from .parallel.mesh import PHYS, SPEC, constrain
+
+        out = self.bases[0].backward(constrain(vhat, SPEC), 0, self.method)
+        out = self.bases[1].backward(constrain(out, PHYS), 1, self.method)
+        return constrain(out, PHYS)
 
     def backward_ortho(self, c):
         """Physical values from orthogonal-space coefficients (the space the
         reference's scratch ``field`` provides, /root/reference/src/navier_stokes/navier.rs:256)."""
-        out = self.bases[1].backward_ortho(c, 1, self.method)
-        return self.bases[0].backward_ortho(out, 0, self.method)
+        from .parallel.mesh import PHYS, SPEC, constrain
+
+        out = self.bases[0].backward_ortho(constrain(c, SPEC), 0, self.method)
+        out = self.bases[1].backward_ortho(constrain(out, PHYS), 1, self.method)
+        return constrain(out, PHYS)
 
     def to_ortho(self, vhat):
         out = self.bases[0].to_ortho(vhat, 0)

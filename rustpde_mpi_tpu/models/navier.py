@@ -71,9 +71,14 @@ class Navier2D(Integrate):
         aspect: float,
         bc: str,
         periodic: bool,
+        mesh=None,
     ):
         if bc not in ("rbc", "hc"):
             raise ValueError(f"boundary condition type {bc!r} not recognized")
+        # pencil-sharding mesh (None = single device); one model serves both —
+        # the reference's navier_stokes vs navier_stokes_mpi duplication is
+        # deliberately not reproduced (SURVEY.md S1 note)
+        self.mesh = mesh
         self.nx, self.ny = nx, ny
         self.dt = dt
         self.time = 0.0
@@ -125,36 +130,56 @@ class Navier2D(Integrate):
         )
 
         # boundary-condition lift fields as device constants
-        self._build_bc_fields(xs, ys)
+        with self._scope():
+            self._build_bc_fields(xs, ys)
 
         # jitted step + observables
         self._step = jax.jit(self._make_step())
         self._step_n = jax.jit(self._make_step_n(), static_argnums=1)
         self._obs_fn = jax.jit(self._make_observables())
 
-        self.state = NavierState(
-            temp=self.temp_space.ndarray_spectral(),
-            velx=self.velx_space.ndarray_spectral(),
-            vely=self.vely_space.ndarray_spectral(),
-            pres=self.pres_space.ndarray_spectral(),
-            pseu=self.pseu_space.ndarray_spectral(),
-        )
+        with self._scope():
+            self.state = NavierState(
+                temp=self._place(self.temp_space.ndarray_spectral()),
+                velx=self._place(self.velx_space.ndarray_spectral()),
+                vely=self._place(self.vely_space.ndarray_spectral()),
+                pres=self._place(self.pres_space.ndarray_spectral()),
+                pseu=self._place(self.pseu_space.ndarray_spectral()),
+            )
+
+    # -- sharding helpers ----------------------------------------------------
+
+    def _scope(self):
+        """Activate this model's mesh for the duration of a trace/dispatch."""
+        from ..parallel.mesh import use_mesh
+
+        if self.mesh is None:
+            import contextlib
+
+            return contextlib.nullcontext()
+        return use_mesh(self.mesh)
+
+    def _place(self, arr):
+        """Put a spectral array into x-pencil layout under the mesh."""
+        from ..parallel.mesh import SPEC, device_put
+
+        return device_put(arr, SPEC)
 
     # -- construction --------------------------------------------------------
 
     @classmethod
-    def new_confined(cls, nx, ny, ra, pr, dt, aspect, bc) -> "Navier2D":
+    def new_confined(cls, nx, ny, ra, pr, dt, aspect, bc, mesh=None) -> "Navier2D":
         """Chebyshev x Chebyshev (fully confined cell), with random IC as in
         the reference (/root/reference/src/navier_stokes/navier.rs:215-308)."""
-        model = cls(nx, ny, ra, pr, dt, aspect, bc, periodic=False)
+        model = cls(nx, ny, ra, pr, dt, aspect, bc, periodic=False, mesh=mesh)
         model.init_random(0.1)
         return model
 
     @classmethod
-    def new_periodic(cls, nx, ny, ra, pr, dt, aspect, bc) -> "Navier2D":
+    def new_periodic(cls, nx, ny, ra, pr, dt, aspect, bc, mesh=None) -> "Navier2D":
         """Fourier x Chebyshev (horizontally periodic)
         (/root/reference/src/navier_stokes/navier.rs:336-428)."""
-        model = cls(nx, ny, ra, pr, dt, aspect, bc, periodic=True)
+        model = cls(nx, ny, ra, pr, dt, aspect, bc, periodic=True, mesh=mesh)
         model.init_random(0.1)
         return model
 
@@ -210,13 +235,15 @@ class Navier2D(Integrate):
     def set_field(self, name: str, values: np.ndarray) -> None:
         """Set one variable from physical values (host -> device forward)."""
         space: Space2 = getattr(self, f"{name}_space")
-        vhat = space.forward(jnp.asarray(values, dtype=config.real_dtype()))
-        self.state = self.state._replace(**{name: vhat})
+        with self._scope():
+            vhat = space.forward(jnp.asarray(values, dtype=config.real_dtype()))
+            self.state = self.state._replace(**{name: self._place(vhat)})
 
     def get_field(self, name: str) -> np.ndarray:
         """Physical values of one variable (device backward -> host)."""
         space: Space2 = getattr(self, f"{name}_space")
-        return np.asarray(space.backward(getattr(self.state, name)))
+        with self._scope():
+            return np.asarray(space.backward(getattr(self.state, name)))
 
     # -- the time step -------------------------------------------------------
 
@@ -356,7 +383,8 @@ class Navier2D(Integrate):
     # -- Integrate protocol --------------------------------------------------
 
     def update(self) -> None:
-        self.state = self._step(self.state)
+        with self._scope():
+            self.state = self._step(self.state)
         self.time += self.dt
 
     def update_n(self, n: int) -> None:
@@ -367,10 +395,11 @@ class Navier2D(Integrate):
         recompile for every new chunk length, e.g. the tail of an integrate
         interval)."""
         remaining = int(n)
-        while remaining > 0:
-            bucket = 1 << (remaining.bit_length() - 1)
-            self.state = self._step_n(self.state, bucket)
-            remaining -= bucket
+        with self._scope():
+            while remaining > 0:
+                bucket = 1 << (remaining.bit_length() - 1)
+                self.state = self._step_n(self.state, bucket)
+                remaining -= bucket
         self.time += n * self.dt
 
     def get_time(self) -> float:
@@ -383,7 +412,8 @@ class Navier2D(Integrate):
         """(Nu, Nuvol, Re, |div|) — one fused device dispatch, cached per
         state so callback printing + exit checks don't recompute."""
         if self._obs_cache is None or self._obs_cache[0] is not self.state:
-            values = tuple(float(v) for v in self._obs_fn(self.state))
+            with self._scope():
+                values = tuple(float(v) for v in self._obs_fn(self.state))
             self._obs_cache = (self.state, values)
         return self._obs_cache[1]
 

@@ -1,0 +1,14 @@
+"""Distributed execution layer: device mesh + pencil sharding."""
+
+from .mesh import (  # noqa: F401
+    AXIS,
+    PHYS,
+    SPEC,
+    active_mesh,
+    constrain,
+    device_put,
+    make_mesh,
+    set_mesh,
+    sharding,
+    use_mesh,
+)

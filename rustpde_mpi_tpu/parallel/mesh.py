@@ -1,0 +1,109 @@
+"""Device mesh + pencil-sharding layer.
+
+TPU rebuild of the reference's distributed backend (funspace::spaces_mpi /
+Decomp2d, SURVEY.md S2.2-S2.3): a 1-D device mesh over which 2-D fields are
+pencil-decomposed.  The reference's convention is kept exactly —
+
+* **physical** data in y-pencils: axis 0 (x) distributed, P("p", None)
+* **spectral** data in x-pencils: axis 1 (y) distributed, P(None, "p")
+
+but instead of hand-written MPI all-to-alls
+(/root/reference/src/field_mpi.rs:455-477) the repartitions are expressed as
+``jax.lax.with_sharding_constraint`` at the pencil-flip points inside
+transforms and solvers; XLA GSPMD inserts the all-to-all collectives and
+overlaps them with compute.  One code path serves serial and sharded
+execution: with no active mesh every constraint is a no-op, so the physics
+layer (models/navier.py) is written once — the reference's duplicated
+navier_stokes vs navier_stokes_mpi modules collapse into one.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+AXIS = "p"
+
+_ACTIVE: Mesh | None = None
+
+# pencil specs (reference convention, /root/reference/src/field_mpi.rs:71-88)
+PHYS = (AXIS, None)  # y-pencil: x distributed
+SPEC = (None, AXIS)  # x-pencil: y distributed
+
+
+def make_mesh(devices=None) -> Mesh:
+    """1-D mesh over all (or the given) devices."""
+    if devices is None:
+        devices = jax.devices()
+    return Mesh(np.array(devices), (AXIS,))
+
+
+def set_mesh(mesh: Mesh | None) -> None:
+    """Install ``mesh`` as the active pencil mesh (None disables sharding)."""
+    global _ACTIVE
+    _ACTIVE = mesh
+
+
+def active_mesh() -> Mesh | None:
+    return _ACTIVE
+
+
+class use_mesh:
+    """Context manager scoping an active mesh."""
+
+    def __init__(self, mesh: Mesh | None):
+        self.mesh = mesh
+        self.prev: Mesh | None = None
+
+    def __enter__(self):
+        self.prev = active_mesh()
+        set_mesh(self.mesh)
+        return self.mesh
+
+    def __exit__(self, *exc):
+        set_mesh(self.prev)
+        return False
+
+
+def sharding(spec: tuple) -> NamedSharding | None:
+    mesh = active_mesh()
+    if mesh is None:
+        return None
+    return NamedSharding(mesh, PartitionSpec(*spec))
+
+
+def constrain(x, spec: tuple):
+    """Pin ``x`` to a pencil layout inside a jitted computation; no-op without
+    an active mesh.  This is the TPU equivalent of the reference's
+    transpose_x_to_y/transpose_y_to_x calls — the collective itself is left
+    to XLA.  Outside a trace (eager setup code) it becomes a resharding."""
+    s = sharding(spec)
+    if s is None:
+        return x
+    if isinstance(x, jax.core.Tracer):
+        return jax.lax.with_sharding_constraint(x, s)
+    return device_put(x, spec)
+
+
+def device_put(x, spec: tuple):
+    """Place an array in pencil layout (host->device with sharding).
+
+    Spectral grid sizes are typically odd (129, 1025, ...), so sharded dims
+    are often not divisible by the mesh.  Explicit placement (device_put /
+    out_shardings) rejects that in JAX; only in-jit sharding constraints pad.
+    Non-divisible arrays are therefore left as-is here — the constraints
+    inside the first jitted step distribute them."""
+    s = sharding(spec)
+    if s is None:
+        return x
+    import jax.numpy as jnp
+
+    mesh = active_mesh()
+    arr = jnp.asarray(x)
+    divisible = all(
+        sp is None or arr.shape[i] % mesh.shape[sp] == 0 for i, sp in enumerate(spec)
+    )
+    if divisible:
+        return jax.device_put(arr, s)
+    return arr

@@ -123,14 +123,24 @@ class HholtzAdi:
             )
 
     def solve(self, rhs):
-        """rhs in ortho space -> solution in composite space."""
-        out = rhs
-        for axis in (0, 1):
-            if self.matvec[axis] is not None:
-                out = apply_matrix(self.matvec[axis], out, axis)
-        out = self.solvers[0].solve(out, 0)
-        out = self.solvers[1].solve(out, 1)
-        return out
+        """rhs in ortho space -> solution in composite space.
+
+        Under a parallel mesh the axis solves run on the pencil whose solve
+        axis is local (the reference's HholtzAdiMpi transpose pattern,
+        /root/reference/src/solver_mpi/hholtz_adi.rs:105-145); the pencil
+        flips are sharding constraints, XLA inserts the all-to-alls."""
+        from .parallel.mesh import PHYS, SPEC, constrain
+
+        out = constrain(rhs, SPEC)
+        if self.matvec[0] is not None:
+            out = apply_matrix(self.matvec[0], out, 0)
+        out = constrain(out, PHYS)
+        if self.matvec[1] is not None:
+            out = apply_matrix(self.matvec[1], out, 1)
+        out = self.solvers[1].solve(out, 1)  # axis-1 recurrence, lanes = axis 0
+        out = constrain(out, SPEC)
+        out = self.solvers[0].solve(out, 0)  # axis-0 recurrence, lanes = axis 1
+        return constrain(out, SPEC)
 
 
 class TensorSolver:
@@ -178,13 +188,20 @@ class TensorSolver:
         self._refactor()
 
     def solve(self, rhs):
-        out = rhs
+        """Under a parallel mesh: GEMMs run on the x-pencil (axis 0 local),
+        the per-eigenvalue banded solves on the y-pencil where the eigenvalue
+        lanes (axis 0) are sharded — the reference's PoissonMpi lam-slicing
+        (/root/reference/src/solver_mpi/poisson.rs:139-187)."""
+        from .parallel.mesh import PHYS, SPEC, constrain
+
+        out = constrain(rhs, SPEC)
         if self.fwd is not None:
             out = apply_matrix(self.fwd, out, 0)
-        out = self.banded.solve(out, 1)
+        out = self.banded.solve(constrain(out, PHYS), 1)
+        out = constrain(out, SPEC)
         if self.bwd is not None:
             out = apply_matrix(self.bwd, out, 0)
-        return out
+        return constrain(out, SPEC)
 
 
 class _TensorBased:
@@ -214,9 +231,11 @@ class _TensorBased:
         )
 
     def solve(self, rhs):
+        from .parallel.mesh import PHYS, constrain
+
         out = rhs
         if self.matvec[1] is not None:
-            out = apply_matrix(self.matvec[1], out, 1)
+            out = apply_matrix(self.matvec[1], constrain(out, PHYS), 1)
         return self.tensor.solve(out)
 
 
