@@ -1,0 +1,79 @@
+"""Benchmark: timesteps/sec of the confined 2-D RBC DNS at 1025^2.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Config follows BASELINE.json #4 (1025^2, Ra=1e9).  Runs f32 on the TPU by
+default (RUSTPDE_X64=0); override via env:
+
+    RUSTPDE_BENCH_NX     grid size              (default 1025)
+    RUSTPDE_BENCH_STEPS  timed steps            (default 64)
+    RUSTPDE_X64          1 for f64 parity mode  (default 0 here)
+
+``vs_baseline``: the reference publishes no numbers and cannot be built in
+this container (no Rust toolchain), so the recorded baseline is this
+framework's own CPU path (f64, banded solvers — algorithmically the
+reference's serial configuration) measured on this host at the same config;
+see BASELINE.md "Measured stand-in baseline".
+"""
+
+import json
+import os
+import sys
+import time
+
+os.environ.setdefault("RUSTPDE_X64", "0")
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+# CPU f64 banded-path steps/s at 1025^2 Ra=1e9 measured on this container's
+# host CPU, 2026-07-29 (see BASELINE.md "Measured stand-in baseline"); the
+# denominator for vs_baseline.
+CPU_BASELINE_STEPS_PER_SEC = 0.188
+
+
+def main() -> int:
+    import jax
+
+    from rustpde_mpi_tpu import Navier2D
+
+    nx = int(os.environ.get("RUSTPDE_BENCH_NX", "1025"))
+    steps = int(os.environ.get("RUSTPDE_BENCH_STEPS", "64"))
+
+    import numpy as np
+
+    def sync(m):
+        # a data readback, not just block_until_ready: the axon TPU relay's
+        # dispatch is async past block_until_ready, so only materializing
+        # bytes on the host guarantees the computation finished
+        return np.asarray(m.state.temp[:1, :1])
+
+    model = Navier2D.new_confined(nx, nx, 1e9, 1.0, 1e-4, 1.0, "rbc")
+    model.update_n(steps)  # compile the exact bucket sequence + warm up
+    sync(model)
+
+    t0 = time.perf_counter()
+    model.update_n(steps)
+    sync(model)
+    elapsed = time.perf_counter() - t0
+
+    value = steps / elapsed
+    nu, _, _, div = model.get_observables()
+    ok = all(map(lambda v: v == v, (nu, div)))  # NaN guard
+
+    vs = value / CPU_BASELINE_STEPS_PER_SEC if CPU_BASELINE_STEPS_PER_SEC else 0.0
+    print(
+        json.dumps(
+            {
+                "metric": f"timesteps/sec, 2D RBC confined {nx}x{nx} Ra=1e9 "
+                f"({'f64' if os.environ.get('RUSTPDE_X64') == '1' else 'f32'}, "
+                f"{jax.devices()[0].platform})",
+                "value": round(value, 3),
+                "unit": "steps/s",
+                "vs_baseline": round(vs, 2),
+            }
+        )
+    )
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -270,28 +270,48 @@ class Base:
         return tr.apply_diag(g, vhat, axis)
 
 
+import weakref
+
+_BASE_CACHE: "weakref.WeakValueDictionary[tuple[BaseKind, int], Base]" = (
+    weakref.WeakValueDictionary()
+)
+
+
+def _cached_base(kind: BaseKind, n: int) -> Base:
+    """Bases are immutable operator factories — share one instance per
+    (kind, n) so repeated constructions (e.g. the velx and vely spaces of a
+    model) reuse the same device-resident transform matrices.  Weak values:
+    once no space references a base, its O(n^2) device matrices are freed."""
+    key = (kind, n)
+    base = _BASE_CACHE.get(key)
+    if base is None:
+        base = Base(kind, n)
+        _BASE_CACHE[key] = base
+    return base
+
+
 def chebyshev(n: int) -> Base:
-    return Base(BaseKind.CHEBYSHEV, n)
+    return _cached_base(BaseKind.CHEBYSHEV, n)
 
 
 def cheb_dirichlet(n: int) -> Base:
-    return Base(BaseKind.CHEB_DIRICHLET, n)
+    return _cached_base(BaseKind.CHEB_DIRICHLET, n)
 
 
 def cheb_neumann(n: int) -> Base:
-    return Base(BaseKind.CHEB_NEUMANN, n)
+    return _cached_base(BaseKind.CHEB_NEUMANN, n)
 
 
 def cheb_dirichlet_neumann(n: int) -> Base:
-    return Base(BaseKind.CHEB_DIRICHLET_NEUMANN, n)
+    return _cached_base(BaseKind.CHEB_DIRICHLET_NEUMANN, n)
 
 
 def fourier_r2c(n: int) -> Base:
-    return Base(BaseKind.FOURIER_R2C, n)
+    return _cached_base(BaseKind.FOURIER_R2C, n)
 
 
 def fourier_c2c(n: int) -> Base:
-    return Base(BaseKind.FOURIER_C2C, n)
+    return _cached_base(BaseKind.FOURIER_C2C, n)
 
 
 class Space2:
@@ -363,20 +383,31 @@ class Space2:
     # [transform x] (/root/reference/src/field_mpi.rs:324-333), with the
     # all-to-all left to XLA GSPMD.
 
+    def _axis_method(self, axis: int) -> str:
+        """Per-axis transform path; under an active mesh Chebyshev axes use
+        the (identical) matmul form — GSPMD shards GEMMs cleanly, while the
+        XLA CPU FFT rejects the padded layouts non-divisible shardings
+        produce."""
+        from .parallel.mesh import active_mesh
+
+        if active_mesh() is not None and self.bases[axis].kind.is_chebyshev:
+            return "matmul"
+        return self.method
+
     def forward(self, v):
         """Physical (n_x, n_y) -> spectral (m_x, m_y)."""
         from .parallel.mesh import PHYS, SPEC, constrain
 
-        out = self.bases[1].forward(constrain(v, PHYS), 1, self.method)
-        out = self.bases[0].forward(constrain(out, SPEC), 0, self.method)
+        out = self.bases[1].forward(constrain(v, PHYS), 1, self._axis_method(1))
+        out = self.bases[0].forward(constrain(out, SPEC), 0, self._axis_method(0))
         return constrain(out, SPEC)
 
     def backward(self, vhat):
         """Spectral (m_x, m_y) -> physical (n_x, n_y)."""
         from .parallel.mesh import PHYS, SPEC, constrain
 
-        out = self.bases[0].backward(constrain(vhat, SPEC), 0, self.method)
-        out = self.bases[1].backward(constrain(out, PHYS), 1, self.method)
+        out = self.bases[0].backward(constrain(vhat, SPEC), 0, self._axis_method(0))
+        out = self.bases[1].backward(constrain(out, PHYS), 1, self._axis_method(1))
         return constrain(out, PHYS)
 
     def backward_ortho(self, c):
@@ -384,8 +415,8 @@ class Space2:
         reference's scratch ``field`` provides, /root/reference/src/navier_stokes/navier.rs:256)."""
         from .parallel.mesh import PHYS, SPEC, constrain
 
-        out = self.bases[0].backward_ortho(constrain(c, SPEC), 0, self.method)
-        out = self.bases[1].backward_ortho(constrain(out, PHYS), 1, self.method)
+        out = self.bases[0].backward_ortho(constrain(c, SPEC), 0, self._axis_method(0))
+        out = self.bases[1].backward_ortho(constrain(out, PHYS), 1, self._axis_method(1))
         return constrain(out, PHYS)
 
     def to_ortho(self, vhat):

@@ -96,9 +96,11 @@ class Navier2D(Integrate):
         x_full = fourier_r2c if periodic else chebyshev
         x_neumann = fourier_r2c if periodic else cheb_neumann
 
-        # spaces per variable (/root/reference/src/navier_stokes/navier.rs:235-256,356-376)
+        # spaces per variable (/root/reference/src/navier_stokes/navier.rs:235-256,356-376);
+        # velx/vely share one space object (identical bases -> shared operator
+        # constants on device)
         self.velx_space = Space2(x_base(nx), cheb_dirichlet(ny))
-        self.vely_space = Space2(x_base(nx), cheb_dirichlet(ny))
+        self.vely_space = self.velx_space
         temp_ybase = cheb_dirichlet(ny) if bc == "rbc" else cheb_dirichlet_neumann(ny)
         self.temp_space = Space2(x_neumann(nx), temp_ybase)
         self.pres_space = Space2(x_full(nx), chebyshev(ny))
@@ -120,7 +122,7 @@ class Navier2D(Integrate):
         # implicit solvers (/root/reference/src/navier_stokes/navier.rs:263-275)
         sx2, sy2 = self.scale[0] ** 2, self.scale[1] ** 2
         self.solver_velx = HholtzAdi(self.velx_space, (dt * nu / sx2, dt * nu / sy2))
-        self.solver_vely = HholtzAdi(self.vely_space, (dt * nu / sx2, dt * nu / sy2))
+        self.solver_vely = self.solver_velx  # identical operator, shared factors
         self.solver_temp = HholtzAdi(self.temp_space, (dt * ka / sx2, dt * ka / sy2))
         self.solver_pres = Poisson(self.pseu_space, (1.0 / sx2, 1.0 / sy2))
 
@@ -134,9 +136,13 @@ class Navier2D(Integrate):
             self._build_bc_fields(xs, ys)
 
         # jitted step + observables
-        self._step = jax.jit(self._make_step())
-        self._step_n = jax.jit(self._make_step_n(), static_argnums=1)
-        self._obs_fn = jax.jit(self._make_observables())
+        # jit with closure-converted constants: the dense transform / solver
+        # matrices are hoisted out of the traced program and passed as
+        # device-resident runtime arguments instead of being embedded in the
+        # HLO — at 2049^2 the embedded-constant program exceeds what the TPU
+        # compile service accepts (hundreds of MB), while the hoisted program
+        # is a few hundred KB for any grid size.
+        self._compile_entry_points()
 
         with self._scope():
             self.state = NavierState(
@@ -146,6 +152,44 @@ class Navier2D(Integrate):
                 pres=self._place(self.pres_space.ndarray_spectral()),
                 pseu=self._place(self.pseu_space.ndarray_spectral()),
             )
+
+    def _compile_entry_points(self) -> None:
+        example = NavierState(
+            temp=jax.ShapeDtypeStruct(
+                self.temp_space.shape_spectral, self.temp_space.spectral_dtype()
+            ),
+            velx=jax.ShapeDtypeStruct(
+                self.velx_space.shape_spectral, self.velx_space.spectral_dtype()
+            ),
+            vely=jax.ShapeDtypeStruct(
+                self.vely_space.shape_spectral, self.vely_space.spectral_dtype()
+            ),
+            pres=jax.ShapeDtypeStruct(
+                self.pres_space.shape_spectral, self.pres_space.spectral_dtype()
+            ),
+            pseu=jax.ShapeDtypeStruct(
+                self.pseu_space.shape_spectral, self.pseu_space.spectral_dtype()
+            ),
+        )
+        from ..utils.jit import hoist_constants
+
+        with self._scope():
+            step_cc, step_consts = hoist_constants(self._make_step(), example)
+            obs_cc, obs_consts = hoist_constants(self._make_observables(), example)
+        self._step_consts = step_consts
+        self._obs_consts = obs_consts
+        step_jit = jax.jit(step_cc)
+        self._step = lambda s: step_jit(self._step_consts, s)
+
+        def step_n(consts, state, n: int):
+            return jax.lax.scan(
+                lambda c, _: (step_cc(consts, c), None), state, None, length=n
+            )[0]
+
+        step_n_jit = jax.jit(step_n, static_argnames=("n",))
+        self._step_n = lambda s, n: step_n_jit(self._step_consts, s, n=n)
+        obs_jit = jax.jit(obs_cc)
+        self._obs_fn = lambda s: obs_jit(self._obs_consts, s)
 
     # -- sharding helpers ----------------------------------------------------
 
@@ -315,14 +359,6 @@ class Navier2D(Integrate):
             return NavierState(temp_n, velx_n, vely_n, pres_n, pseu_n)
 
         return step
-
-    def _make_step_n(self):
-        step = self._make_step()
-
-        def step_n(state: NavierState, n: int) -> NavierState:
-            return jax.lax.scan(lambda s, _: (step(s), None), state, None, length=n)[0]
-
-        return step_n
 
     def _make_div(self):
         sp_u, sp_v = self.velx_space, self.vely_space
