@@ -83,25 +83,44 @@ class Field2:
     # -- averages (volume-weighted, /root/reference/src/field/average.rs) ---
 
     def average_axis(self, axis: int):
-        return average_axis(self.v, self.x, self.dx, axis)
+        periodic = self.space.bases[axis].is_periodic
+        return average_axis(self.v, self.x, self.dx, axis, periodic=periodic)
 
     def average(self):
-        return average(self.v, self.x, self.dx)
+        periodic = tuple(b.is_periodic for b in self.space.bases)
+        return average(self.v, self.x, self.dx, periodic=periodic)
 
 
-def average_axis(v, x, dx, axis: int):
+def _axis_length(x, dx, axis: int, periodic: bool) -> float:
+    """Axis length for the average weight.  Deliberate fix over the reference
+    (/root/reference/src/field/average.rs:28): a periodic axis spans a full
+    period (|x[-1]-x[0]| + dx), so weights sum to 1 instead of n/(n-1)."""
+    span = abs(float(x[axis][-1] - x[axis][0]))
+    if periodic:
+        span += float(dx[axis][0])
+    return span
+
+
+def average_weights(x: np.ndarray, periodic: bool) -> np.ndarray:
+    """dx/L quadrature weights along one axis, summing to 1 (scale-invariant;
+    the single home of the full-period periodic normalization)."""
+    dx = grid_deltas(x, periodic)
+    return dx / _axis_length([x], [dx], 0, periodic)
+
+
+def average_axis(v, x, dx, axis: int, periodic: bool = False):
     """Volume-weighted average along ``axis`` (trapezoid-like dx weights)."""
-    length = abs(float(x[axis][-1] - x[axis][0]))
+    length = _axis_length(x, dx, axis, periodic)
     w = jnp.asarray(dx[axis] / length, dtype=v.dtype)
     shape = [1, 1]
     shape[axis] = w.shape[0]
     return jnp.sum(v * w.reshape(shape), axis=axis)
 
 
-def average(v, x, dx):
+def average(v, x, dx, periodic: tuple[bool, bool] = (False, False)):
     """Full volume-weighted average."""
-    ax = average_axis(v, x, dx, 0)
-    length = abs(float(x[1][-1] - x[1][0]))
+    ax = average_axis(v, x, dx, 0, periodic=periodic[0])
+    length = _axis_length(x, dx, 1, periodic[1])
     w = jnp.asarray(dx[1] / length, dtype=v.dtype)
     return jnp.sum(ax * w)
 

@@ -36,7 +36,7 @@ from ..bases import (
     chebyshev,
     fourier_r2c,
 )
-from ..field import grid_deltas
+from ..field import average_weights
 from ..solver import HholtzAdi, Poisson
 from ..utils.integrate import Integrate
 from . import boundary_conditions as bcs
@@ -111,10 +111,11 @@ class Navier2D(Integrate):
         # grid (unscaled master coords; physical coords = coords * scale)
         self.x = [b.points * s for b, s in zip(self.field_space.bases, self.scale)]
         xs, ys = (b.points for b in self.field_space.bases)
-        # average weights dx/L exactly as the reference's average_axis
-        # (/root/reference/src/field/average.rs:26-35); dx/L is scale-invariant
-        w0 = grid_deltas(xs, self.field_space.base_x.is_periodic) / abs(xs[-1] - xs[0])
-        w1 = grid_deltas(ys, False) / abs(ys[-1] - ys[0])
+        # average weights dx/L as in the reference's average_axis
+        # (/root/reference/src/field/average.rs:26-35), with this repo's
+        # full-period normalization for periodic axes (field.average_weights)
+        w0 = average_weights(xs, self.field_space.base_x.is_periodic)
+        w1 = average_weights(ys, False)
         rdt = config.real_dtype()
         self._w0 = jnp.asarray(w0, dtype=rdt)
         self._w1 = jnp.asarray(w1, dtype=rdt)
@@ -236,10 +237,8 @@ class Navier2D(Integrate):
         dt, ka = self.dt, self.params["ka"]
         if self.bc == "rbc":
             tempbc_v = bcs.bc_rbc_values(xs, ys)
-            presbc_v = bcs.pres_bc_rbc_values(xs, ys)
         else:
             tempbc_v = bcs.bc_hc_values(xs, ys)
-            presbc_v = None
         rdt = config.real_dtype()
         that = sp.forward(jnp.asarray(tempbc_v, dtype=rdt))
         self.tempbc_ortho = that
@@ -250,9 +249,10 @@ class Navier2D(Integrate):
         self._tempbc_diff = dt * ka * (
             sp.gradient(that, (2, 0), scale) + sp.gradient(that, (0, 2), scale)
         )
-        self.presbc_ortho = (
-            sp.forward(jnp.asarray(presbc_v, dtype=rdt)) if presbc_v is not None else None
-        )
+        # NOTE: the reference also builds a presbc lift field but never
+        # consumes it in the time loop or the snapshot writer
+        # (/root/reference/src/navier_stokes/navier_io.rs:44-62); the profile
+        # itself remains available as bcs.pres_bc_rbc_values.
 
     # -- initial conditions --------------------------------------------------
 

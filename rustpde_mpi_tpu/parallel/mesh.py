@@ -81,9 +81,20 @@ def constrain(x, spec: tuple):
     s = sharding(spec)
     if s is None:
         return x
-    if isinstance(x, jax.core.Tracer):
+    if _is_tracer(x):
         return jax.lax.with_sharding_constraint(x, s)
     return device_put(x, spec)
+
+
+_TRACER_TYPE = getattr(jax.core, "Tracer", None)  # deprecated home; may vanish
+
+
+def _is_tracer(x) -> bool:
+    if _TRACER_TYPE is not None:
+        return isinstance(x, _TRACER_TYPE)
+    # fallback for JAX releases that drop jax.core.Tracer: concrete arrays
+    # expose addressable shards, tracers don't
+    return isinstance(x, jax.Array) and not hasattr(x, "addressable_shards")
 
 
 def device_put(x, spec: tuple):

@@ -9,8 +9,10 @@ src/io/read_write_hdf5.rs:
   (/root/reference/src/io/read_write_hdf5.rs:171-188),
 * scalars ``time`` + physics params at the file root,
 * restart restores spectral coefficients, supporting **resolution change via
-  spectral truncation/zero-padding with Fourier renormalization**
-  (/root/reference/src/field/io.rs:151-176).
+  spectral truncation/zero-padding** with r2c Nyquist-mode bookkeeping (no
+  Fourier renormalization — see :func:`interpolate_2d`; the reference's
+  (new-1)/(old-1) factor compensates its unnormalized rustfft convention,
+  /root/reference/src/field/io.rs:151-176).
 
 One deliberate fix over the reference: the reference writes the coordinate
 array into both the ``x`` and ``dx`` datasets (field/io.rs:96-99); here ``dx``
@@ -46,16 +48,49 @@ def _read_array(group, name: str, is_complex: bool) -> np.ndarray:
     return np.asarray(group[name])
 
 
-def interpolate_2d(old: np.ndarray, new_shape: tuple[int, int], kind_x: BaseKind) -> np.ndarray:
+def interpolate_2d(
+    old: np.ndarray,
+    new_shape: tuple[int, int],
+    kind_x: BaseKind,
+    old_nx: int | None = None,
+    new_nx: int | None = None,
+) -> np.ndarray:
     """Spectral interpolation on resolution change: truncate / zero-pad the
-    coefficient array, with r2c renormalization on axis 0
-    (/root/reference/src/field/io.rs:151-176)."""
+    coefficient array (/root/reference/src/field/io.rs:151-176).
+
+    Unlike the reference, no global Fourier renormalization is applied: the
+    reference's rustfft forward is unnormalized (coefficients scale with n),
+    while this repo's r2c forward is amplitude-normalized (rfft/n), so
+    coefficients are grid-size independent.  What the r2c axis does need is
+    the Nyquist-mode bookkeeping (``old_nx``/``new_nx`` are the physical grid
+    sizes): an even-grid Nyquist coefficient represents cos(Nx) counted once,
+    so when it becomes a regular +k mode of the new grid it must be halved,
+    and when a regular +k/-k pair lands on the new grid's Nyquist it folds to
+    double the real part.  This covers resolution changes that keep the
+    spectral shape but flip grid parity (e.g. nx 16 -> 17)."""
     new = np.zeros(new_shape, dtype=old.dtype)
     s0 = min(old.shape[0], new_shape[0])
     s1 = min(old.shape[1], new_shape[1])
     new[:s0, :s1] = old[:s0, :s1]
     if kind_x == BaseKind.FOURIER_R2C:
-        new *= (new_shape[0] - 1) / (old.shape[0] - 1)
+        if old_nx is None:
+            import warnings
+
+            warnings.warn(
+                "r2c restart interpolation without the source grid size "
+                "(missing 'x' dataset): assuming an even source grid for "
+                "Nyquist-mode bookkeeping",
+                stacklevel=2,
+            )
+            old_nx = 2 * (old.shape[0] - 1)
+        old_nyq = old.shape[0] - 1 if old_nx % 2 == 0 else None
+        new_nyq = (
+            new_shape[0] - 1 if new_nx is not None and new_nx % 2 == 0 else None
+        )
+        if old_nyq is not None and old_nyq < s0 and old_nyq != new_nyq:
+            new[old_nyq, :] *= 0.5  # old Nyquist -> regular +k mode
+        if new_nyq is not None and new_nyq < s0 and new_nyq != old_nyq:
+            new[new_nyq, :] = 2.0 * new[new_nyq, :].real  # +-k fold onto Nyquist
     return new
 
 
@@ -74,8 +109,23 @@ def read_field_vhat(h5, varname: str, space: Space2) -> np.ndarray:
     """Read one field's spectral coefficients, interpolating on mismatch."""
     grp = h5[varname]
     data = _read_array(grp, "vhat", space.spectral_is_complex)
-    if data.shape != space.shape_spectral:
-        data = interpolate_2d(data, space.shape_spectral, space.base_kind(0))
+    old_nx = grp["x"].shape[0] if "x" in grp else None
+    # interpolate on shape mismatch, and also when the shapes agree but the
+    # r2c grid parity changed (nx 16 -> 17 keeps m = 9 yet re-types the
+    # Nyquist row)
+    parity_flip = (
+        space.base_kind(0) == BaseKind.FOURIER_R2C
+        and old_nx is not None
+        and old_nx % 2 != space.shape_physical[0] % 2
+    )
+    if data.shape != space.shape_spectral or parity_flip:
+        data = interpolate_2d(
+            data,
+            space.shape_spectral,
+            space.base_kind(0),
+            old_nx=old_nx,
+            new_nx=space.shape_physical[0],
+        )
     return data
 
 

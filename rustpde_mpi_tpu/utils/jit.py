@@ -16,6 +16,7 @@ leaving concrete arrays as embedded constants.)
 from __future__ import annotations
 
 import jax
+import jax.extend.core as jex_core
 import jax.numpy as jnp
 
 
@@ -41,7 +42,11 @@ def hoist_constants(fn, *example):
     def converted(consts, *args):
         flat_args, _ = jax.tree.flatten(args)
         expanded = [consts[i] for i in index]
-        out_flat = jax.core.eval_jaxpr(closed.jaxpr, expanded, *flat_args)
+        # jax.extend.core is the stable replay API (jax.core.eval_jaxpr is
+        # deprecated); ClosedJaxpr accepts runtime tracers as consts, which is
+        # exactly the hoisting trick
+        replay = jex_core.jaxpr_as_fun(jex_core.ClosedJaxpr(closed.jaxpr, expanded))
+        out_flat = replay(*flat_args)
         return jax.tree.unflatten(out_tree, out_flat)
 
     return converted, consts

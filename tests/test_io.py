@@ -70,6 +70,46 @@ def test_restart_with_resolution_change(tmp_path):
     assert np.all(np.isfinite(np.asarray(finer.state.temp)))
 
 
+def test_periodic_restart_with_resolution_change(tmp_path):
+    """Periodic x-axis resolution change: the physical field must be
+    preserved, not just coefficient prefixes.  This repo's r2c forward is
+    amplitude-normalized, so a plain spectral zero-pad is exact — the
+    reference's (new-1)/(old-1) renormalization (needed for its unnormalized
+    rustfft convention) would scale the field by O(1)."""
+    model = _run_model(nx=16, ny=17, periodic=True)
+    fname = str(tmp_path / "flow.h5")
+    model.write(fname)
+
+    finer = Navier2D(32, 17, 1e4, 1.0, 0.01, 1.0, "rbc", periodic=True)
+    finer.read(fname)
+    # physical values at the coarse grid's points: the 32-point uniform grid
+    # contains every 16-point grid point at even indices
+    coarse_v = model.get_field("temp")
+    fine_v = finer.get_field("temp")
+    np.testing.assert_allclose(fine_v[::2, :], coarse_v, atol=1e-13)
+    # observables agree (y-grid unchanged, x interpolation exact)
+    assert finer.eval_nu() == pytest.approx(model.eval_nu(), rel=1e-8)
+    finer.update_n(5)
+    assert np.all(np.isfinite(np.asarray(np.abs(finer.state.temp))))
+
+
+def test_periodic_restart_parity_flip(tmp_path):
+    """nx 16 -> 17 keeps the r2c spectral shape (m=9) but re-types the
+    Nyquist row as a regular +k mode, which must be halved."""
+    model = _run_model(nx=16, ny=17, periodic=True)
+    fname = str(tmp_path / "flow.h5")
+    model.write(fname)
+
+    odd = Navier2D(17, 17, 1e4, 1.0, 0.01, 1.0, "rbc", periodic=True)
+    odd.read(fname)
+    old = np.asarray(model.state.temp)
+    new = np.asarray(odd.state.temp)
+    np.testing.assert_allclose(new[:-1, :], old[:-1, :], atol=1e-14)
+    np.testing.assert_allclose(new[-1, :], 0.5 * old[-1, :], atol=1e-14)
+    # plate Nu depends only on the k=0 column -> unchanged
+    assert odd.eval_nu() == pytest.approx(model.eval_nu(), rel=1e-8)
+
+
 def test_periodic_roundtrip(tmp_path):
     model = _run_model(nx=16, ny=17, periodic=True)
     fname = str(tmp_path / "flow.h5")
