@@ -45,7 +45,13 @@ def default_device_kind() -> str:
 
 
 def is_tpu_like() -> bool:
-    """True on TPU (including the 'axon' tunnel platform)."""
+    """True on TPU (including the 'axon' tunnel platform).
+
+    ``RUSTPDE_FORCE_TPU_PATH=1`` forces True so CI (which runs on CPU,
+    tests/conftest.py) can exercise the execution paths the real TPU uses:
+    matmul transforms, dense ADI solves, fast-diagonalisation Poisson."""
+    if os.environ.get("RUSTPDE_FORCE_TPU_PATH") == "1":
+        return True
     return default_device_kind() not in ("cpu", "gpu", "cuda", "rocm")
 
 

@@ -159,15 +159,3 @@ class DiagSolver:
         shape = [1] * b.ndim
         shape[axis] = d.shape[0]
         return b / d.reshape(shape)
-
-
-def bandwidth_for_kind(kind) -> tuple[int, int]:
-    """Offsets of the preconditioned Helmholtz operator per base kind, as in
-    the reference's solver dispatch (/root/reference/src/solver/hholtz_adi.rs:60-68):
-    Fdma (-2,0,2,4) for dirichlet/neumann/chebyshev, PdmaPlus2 (-2..+4) for
-    dirichlet-neumann."""
-    from ..bases import BaseKind
-
-    if kind == BaseKind.CHEB_DIRICHLET_NEUMANN:
-        return 2, 4
-    return 2, 4

@@ -90,7 +90,17 @@ def quasi_inverse_b2(n: int) -> np.ndarray:
     Classic closed form (ctilde_0 = 2, else 1):
         B2[k, k-2] = ctilde_{k-2} / (4 k (k-1))
         B2[k, k]   = -1 / (2 (k^2 - 1))
-        B2[k, k+2] = 1 / (4 k (k+1))        (only while k+2 < n)
+        B2[k, k+2] = 1 / (4 k (k+1))
+
+    Columns n-2 and n-1 are zeroed: they would multiply second-derivative
+    modes that a degree-(n-1) polynomial cannot have (rows n-2, n-1 of D2 are
+    zero, so the ``laplace_inv_eye`` identity is unaffected).  This matches
+    the funspace/pypde convention — verified against the reference's embedded
+    pypde golden solutions (/root/reference/src/solver/poisson.rs:287-291,
+    hholtz_adi.rs:203-211, tests/test_golden.py) — and it makes the
+    B2-preconditioned eigenpencil exactly real-diagonalizable for every
+    composite Chebyshev base (with the untruncated B2 the Neumann pencil has
+    complex pairs, which the reference's utils::eig would silently drop).
     """
     B2 = np.zeros((n, n))
     for k in range(2, n):
@@ -99,6 +109,7 @@ def quasi_inverse_b2(n: int) -> np.ndarray:
         B2[k, k] = -1.0 / (2.0 * (k * k - 1.0))
         if k + 2 < n:
             B2[k, k + 2] = 1.0 / (4.0 * k * (k + 1.0))
+    B2[:, n - 2 :] = 0.0
     return B2
 
 

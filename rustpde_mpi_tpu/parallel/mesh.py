@@ -93,8 +93,15 @@ def _is_tracer(x) -> bool:
     if _TRACER_TYPE is not None:
         return isinstance(x, _TRACER_TYPE)
     # fallback for JAX releases that drop jax.core.Tracer: concrete arrays
-    # expose addressable shards, tracers don't
-    return isinstance(x, jax.Array) and not hasattr(x, "addressable_shards")
+    # expose addressable shards, while a tracer's accessor raises (a
+    # ConcretizationTypeError, i.e. TypeError — hasattr doesn't swallow it)
+    if not isinstance(x, jax.Array):
+        return False
+    try:
+        x.addressable_shards
+    except Exception:
+        return True
+    return False
 
 
 def device_put(x, spec: tuple):

@@ -5,19 +5,25 @@ TPU rebuild of the reference solver layer (/root/reference/src/solver/):
 * :class:`HholtzAdi` — ``(I - c*D2) u = f`` by alternating-direction-implicit
   1-D solves per axis (same O(dt*c) splitting as the reference,
   /root/reference/src/solver/hholtz_adi.rs:12-16).
-* :class:`TensorSolver` — the `FdmaTensor` analog: eigen-diagonalize axis 0,
-  leaving a banded family along axis 1
+* :class:`TensorSolver` — the `FdmaTensor` analog: eigen-diagonalize axis 0
+  through the B2-preconditioned pencil ``(pinv S)^-1 (peye S)``, leaving a
+  banded family along axis 1
   (/root/reference/src/solver/fdma_tensor.rs:36-71 documents the math).
-  Two deliberate departures from the reference: (a) the per-eigenvalue banded
+  One deliberate departure from the reference: the per-eigenvalue banded
   factorizations are computed ONCE at build time (host numpy) instead of per
-  solve call; (b) axis 0 is diagonalized through the *weak-form* (Galerkin)
-  pencil ``(S^T W D2 S, S^T W S)`` whose spectrum is exactly real for all
-  composite Chebyshev bases — the reference diagonalizes the quasi-inverse-
-  preconditioned pencil and silently drops imaginary parts
-  (/root/reference/src/solver/utils.rs:84-86), which is ill-defined for the
-  Neumann (pressure) operator where that pencil has genuinely complex pairs.
+  solve call (poisson.rs:226-228 re-sweeps every step).
+* :class:`FastDiag` — both axes eigen-diagonalized through the same pencils;
+  solves the *identical* discrete system as :class:`TensorSolver` (tested),
+  but as pure GEMMs + one elementwise divide — the MXU-native path.
 * :class:`Poisson` / :class:`Hholtz` — pressure Poisson (alpha=0, singular
   mode regularized) and exact Helmholtz (alpha=1).
+
+The discretization is reference-exact: the truncated quasi-inverse
+(ops/chebyshev.quasi_inverse_b2) reproduces the reference's embedded pypde
+golden solutions (tests/test_golden.py) and makes the pencil spectrum exactly
+real for every composite base — the imaginary parts the reference's
+utils::eig silently drops (/root/reference/src/solver/utils.rs:84-86) are
+structurally zero under this convention.
 
 All device work is GEMMs (MXU) + one batched banded substitution scan.
 """
@@ -55,12 +61,6 @@ def ingredients_for_hholtz(space: Space2, axis: int):
     return mass, lap, None
 
 
-def ingredients_for_poisson(space: Space2, axis: int):
-    mat_a, mat_b, precond = ingredients_for_hholtz(space, axis)
-    is_diag = space.bases[axis].kind.is_periodic
-    return mat_a, mat_b, precond, is_diag
-
-
 def _sorted_real_eig(x: np.ndarray):
     """Eigendecomposition with eigenvalues sorted descending by real part
     (matching the reference's utils::eig ordering so the singular mode lands
@@ -74,17 +74,23 @@ def _sorted_real_eig(x: np.ndarray):
     return lam, q
 
 
-def weak_form_matrices(base: Base):
-    """Galerkin weak-form pair (G_A, G_B) = (S^T W D2 S, S^T W S) and the
-    ortho->weak projection S^T W for one Chebyshev base."""
-    from .ops import chebyshev as chb
+def _axis_modal_data(space: Space2, axis: int, ci: float, sign: float):
+    """Modal diagonalization of one axis of the preconditioned operator.
 
-    S = base.stencil
-    if base.kind == BaseKind.CHEBYSHEV:
-        S = S[:, 2:]
-    W = np.diag(chb.cheb_weights(base.n))
-    D2 = chb.diff_matrix(base.n, 2)
-    return S.T @ W @ D2 @ S, S.T @ W @ S, S.T @ W
+    Returns ``(lam, fwd, bwd)``: ``lam`` scaled by ``sign * ci``; ``fwd``
+    maps the axis's *ortho-space* rhs into eigenspace (it folds the B2
+    preconditioner in: ``Q^-1 C^-1 pinv``), ``bwd = Q`` maps the eigenspace
+    solution back to composite coefficients.  Fourier axes are already modal:
+    ``lam = sign*ci*(-k^2)``, no maps.  This is the pencil the reference's
+    FdmaTensor diagonalizes (/root/reference/src/solver/fdma_tensor.rs:106-154);
+    under the truncated quasi-inverse its spectrum is exactly real."""
+    base = space.bases[axis]
+    if base.kind.is_periodic:
+        return sign * ci * (-(base.wavenumbers**2)), None, None
+    mat_c, mat_a, precond = ingredients_for_hholtz(space, axis)
+    lam, q = _sorted_real_eig(np.linalg.solve(mat_c, mat_a))
+    fwd = np.linalg.solve(q, np.linalg.solve(mat_c, precond))
+    return sign * ci * lam, fwd, q
 
 
 class _AxisSolver:
@@ -154,47 +160,33 @@ class HholtzAdi:
 
 class TensorSolver:
     """2-D tensor-product solver: ``[(A_x x C_y) + (C_x x A_y) + alpha (C_x x
-    C_y)] u = f``; axis 0 diagonalized (weak-form pencil eig, or
-    already-diagonal Fourier), axis 1 a batch of banded systems factored at
-    build time.
+    C_y)] u = B2 f``; axis 0 diagonalized through the preconditioned pencil
+    (or already-diagonal Fourier), axis 1 a batch of banded systems factored
+    at build time (the reference re-sweeps per solve,
+    /root/reference/src/solver/poisson.rs:226-228).
 
-    ``fwd`` maps the axis-0 *ortho-space* rhs into eigenspace (it folds the
-    Galerkin projection in), so no separate axis-0 preconditioner matvec is
-    applied when ``fwd`` is present."""
+    ``modal0 = (lam0, fwd0, bwd0)`` from :func:`_axis_modal_data` — ``fwd0``
+    maps the axis-0 *ortho-space* rhs into eigenspace (preconditioner folded
+    in), so no separate axis-0 matvec is applied."""
 
-    def __init__(self, a, c, is_diag, alpha: float, weak0=None, fix_singular=False):
+    def __init__(self, modal0, a1, c1, precond1, alpha: float, fix_singular=False):
         dt = config.real_dtype()
-        if is_diag[0]:
-            lam = np.diag(a[0]).copy()
-            self.fwd = self.bwd = None
-        else:
-            g_a, g_b, proj = weak0
-            lam, q = _sorted_real_eig(np.linalg.solve(g_b, g_a))
-            self.fwd = jnp.asarray(
-                np.linalg.solve(q, np.linalg.solve(g_b, proj)), dtype=dt
-            )
-            self.bwd = jnp.asarray(q, dtype=dt)
+        lam, fwd0, bwd0 = modal0
+        self.fwd = jnp.asarray(fwd0, dtype=dt) if fwd0 is not None else None
+        self.bwd = jnp.asarray(bwd0, dtype=dt) if bwd0 is not None else None
         if fix_singular and abs(lam[0]) < 1e-10:
             # pure-Neumann problems: nudge the zero mode so the banded
             # factorization exists (/root/reference/src/solver/poisson.rs:84-87)
-            lam = lam - 1e-10
+            lam = lam.copy()
+            lam -= 1e-10
         self.lam = lam
         self.alpha = alpha
-        self._a1, self._c1 = a[1], c[1]
-        # (A_y + (lam_i + alpha) C_y) factored for every eigenvalue lane i
-        self._refactor()
-
-    def _refactor(self):
-        mats = (
-            self._a1[None, :, :]
-            + (self.lam[:, None, None] + self.alpha) * self._c1[None, :, :]
+        self.matvec1 = (
+            jnp.asarray(precond1, dtype=dt) if precond1 is not None else None
         )
+        # (A_y + (lam_i + alpha) C_y) factored for every eigenvalue lane i
+        mats = a1[None, :, :] + (lam[:, None, None] + alpha) * c1[None, :, :]
         self.banded = BandedSolver(mats, _P, _Q)
-
-    def update_lam(self, lam):
-        """Re-factor after an eigenvalue shift (singularity regularization)."""
-        self.lam = lam
-        self._refactor()
 
     def solve(self, rhs):
         """Under a parallel mesh: GEMMs run on the x-pencil (axis 0 local),
@@ -204,6 +196,9 @@ class TensorSolver:
         from .parallel.mesh import PHYS, SPEC, constrain
 
         out = constrain(rhs, SPEC)
+        if self.matvec1 is not None:
+            out = apply_matrix(self.matvec1, constrain(out, PHYS), 1)
+        out = constrain(out, SPEC)
         if self.fwd is not None:
             out = apply_matrix(self.fwd, out, 0)
         out = self.banded.solve(constrain(out, PHYS), 1)
@@ -214,43 +209,32 @@ class TensorSolver:
 
 
 class FastDiag:
-    """Fast-diagonalisation 2-D solver: ``[c0 D2_x + c1 D2_y] u (+ alpha u) =
-    f`` with BOTH axes eigendecomposed through their weak-form (Galerkin)
-    pencils, so the device solve is 4 GEMMs + 1 elementwise divide — pure MXU
-    work, no sequential recurrence.  This is the TPU-native answer to the
-    reference's FdmaTensor (eig axis 0 + per-eigenvalue banded sweeps along
-    axis 1, /root/reference/src/solver/fdma_tensor.rs:36-71): same discrete
-    solution, but the O(n) Thomas recurrence the reference parallelises with
-    rayon lanes would serialise a TPU, while matmuls saturate it.
+    """Fast-diagonalisation 2-D solver: BOTH axes eigendecomposed through the
+    preconditioned pencils, so the device solve is 4 GEMMs + 1 elementwise
+    divide — pure MXU work, no sequential recurrence.  This is the TPU-native
+    answer to the reference's FdmaTensor (eig axis 0 + per-eigenvalue banded
+    sweeps along axis 1, /root/reference/src/solver/fdma_tensor.rs:36-71):
+    the *identical* discrete solution (same pencils, tested against
+    :class:`TensorSolver`), but the O(n) Thomas recurrence the reference
+    parallelises with rayon lanes would serialise a TPU, while matmuls
+    saturate it.
 
     Fourier axes are already modal (diagonal), so their fwd/bwd maps are
     identity and their eigenvalues are -k^2.
     """
 
-    def __init__(self, space: Space2, c, alpha: float, negate_lap: bool, fix_singular=False):
+    def __init__(self, modal0, modal1, alpha: float, fix_singular=False):
         dt = config.real_dtype()
-        sign = -1.0 if negate_lap else 1.0
-        self.fwd, self.bwd, lams = [], [], []
-        for axis, ci in enumerate(c):
-            base = space.bases[axis]
-            if base.kind.is_periodic:
-                lam = sign * ci * (-(base.wavenumbers**2))
-                self.fwd.append(None)
-                self.bwd.append(None)
-            else:
-                g_a, g_b, proj = weak_form_matrices(base)
-                lam, q = _sorted_real_eig(np.linalg.solve(g_b, g_a))
-                self.fwd.append(
-                    jnp.asarray(np.linalg.solve(q, np.linalg.solve(g_b, proj)), dtype=dt)
-                )
-                self.bwd.append(jnp.asarray(q, dtype=dt))
-                lam = sign * ci * lam
+        lams, self.fwd, self.bwd = [], [], []
+        for lam, fwd, bwd in (modal0, modal1):
+            self.fwd.append(jnp.asarray(fwd, dtype=dt) if fwd is not None else None)
+            self.bwd.append(jnp.asarray(bwd, dtype=dt) if bwd is not None else None)
             lams.append(lam)
         if fix_singular and abs(lams[0][0]) < 1e-10:
             # pure-Neumann zero mode: same nudge as the reference
             # (/root/reference/src/solver/poisson.rs:84-87)
             lams[0] = lams[0].copy()
-            lams[0][0] -= 1e-10
+            lams[0] -= 1e-10
         denom = lams[0][:, None] + lams[1][None, :] + alpha
         self.denom = jnp.asarray(denom, dtype=dt)
 
@@ -276,8 +260,10 @@ class FastDiag:
 
 class _TensorBased:
     """Shared assembly for Poisson/Hholtz: fast-diagonalisation on TPU,
-    eig-axis0 + banded-axis1 tensor solver elsewhere (both solve the same
-    discrete system)."""
+    eig-axis0 + banded-axis1 tensor solver elsewhere.  Both backends
+    diagonalize the same preconditioned pencils, so they solve the same
+    discrete system (tests/test_golden.py asserts equality to machine
+    precision)."""
 
     def __init__(
         self,
@@ -289,41 +275,26 @@ class _TensorBased:
         method: str | None = None,
     ):
         method = method or ("fd" if config.is_tpu_like() else "banded")
-        if method == "fd":
-            self._fd = FastDiag(space, c, alpha, negate_lap, fix_singular)
-            return
-        self._fd = None
-        self.space = space
         sign = -1.0 if negate_lap else 1.0
-        laps, masses, is_diags, self.matvec = [], [], [], []
-        weak0 = None
-        for axis, ci in enumerate(c):
-            mat_a, mat_b, precond, is_diag = ingredients_for_poisson(space, axis)
-            laps.append(sign * ci * mat_b)
-            masses.append(mat_a)
-            is_diags.append(is_diag)
-            # axis 0 rhs projection is folded into the tensor fwd matrix for
-            # Chebyshev axes; only axis 1 keeps an explicit precond matvec
-            if axis == 1 and precond is not None:
-                self.matvec.append(jnp.asarray(precond, dtype=config.real_dtype()))
-            else:
-                self.matvec.append(None)
-        if not is_diags[0]:
-            g_a, g_b, proj = weak_form_matrices(space.bases[0])
-            weak0 = (sign * c[0] * g_a, g_b, proj)
-        self.tensor = TensorSolver(
-            laps, masses, is_diags, alpha, weak0=weak0, fix_singular=fix_singular
-        )
+        modal0 = _axis_modal_data(space, 0, c[0], sign)
+        if method == "fd":
+            modal1 = _axis_modal_data(space, 1, c[1], sign)
+            self._solver = FastDiag(modal0, modal1, alpha, fix_singular)
+        else:
+            # mat_c1 = preconditioned mass (pinv S, or I for Fourier),
+            # mat_a1 = preconditioned laplacian (peye S, or diag(-k^2))
+            mat_c1, mat_a1, precond1 = ingredients_for_hholtz(space, 1)
+            self._solver = TensorSolver(
+                modal0,
+                sign * c[1] * mat_a1,
+                mat_c1,
+                precond1,
+                alpha,
+                fix_singular=fix_singular,
+            )
 
     def solve(self, rhs):
-        if self._fd is not None:
-            return self._fd.solve(rhs)
-        from .parallel.mesh import PHYS, constrain
-
-        out = rhs
-        if self.matvec[1] is not None:
-            out = apply_matrix(self.matvec[1], constrain(out, PHYS), 1)
-        return self.tensor.solve(out)
+        return self._solver.solve(rhs)
 
 
 class Poisson(_TensorBased):

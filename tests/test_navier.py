@@ -29,7 +29,9 @@ def test_projection_controls_divergence():
     model.update_n(20)
     div_early = model.div_norm()
     model.update_n(180)
-    assert model.div_norm() < 1e-4
+    # measured ~1.2e-4 at t=2 under the truncated-B2 (reference-exact)
+    # discretization (was ~1e-4 before it); decays to ~2e-5 by t=6
+    assert model.div_norm() < 2e-4
     assert model.div_norm() < 0.5 * div_early
 
 

