@@ -25,6 +25,13 @@ from .bases import (  # noqa: F401
 )
 from .field import Field2, average, average_axis, norm_l2  # noqa: F401
 from .models.navier import Navier2D, NavierState  # noqa: F401
+from .models.statistics import Statistics  # noqa: F401
+from .models.steady_adjoint import Navier2DAdjoint  # noqa: F401
 from .utils.integrate import Integrate, integrate  # noqa: F401
+from .utils.vorticity import (  # noqa: F401
+    vorticity_auto,
+    vorticity_from_file,
+    vorticity_from_file_periodic,
+)
 
 __version__ = "0.1.0"

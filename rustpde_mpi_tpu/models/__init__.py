@@ -1,3 +1,5 @@
 """Physics model layer: Navier-Stokes DNS and derived solvers."""
 
 from .navier import Navier2D, NavierState  # noqa: F401
+from .statistics import Statistics  # noqa: F401
+from .steady_adjoint import Navier2DAdjoint  # noqa: F401

@@ -36,7 +36,7 @@ from ..bases import (
     chebyshev,
     fourier_r2c,
 )
-from ..field import average_weights
+from ..field import average_weights, norm_l2
 from ..solver import HholtzAdi, Poisson
 from ..utils.integrate import Integrate
 from . import boundary_conditions as bcs
@@ -407,11 +407,7 @@ class Navier2D(Integrate):
             ux = sp_u.backward(state.velx)
             re = avg(jnp.sqrt(ux**2 + uy**2) * 2.0 * scale[1] / nu)
             # divergence norm
-            d = div_fn(state)
-            if jnp.iscomplexobj(d):
-                dnorm = jnp.sqrt(jnp.sum(d.real**2 + d.imag**2))
-            else:
-                dnorm = jnp.sqrt(jnp.sum(d**2))
+            dnorm = norm_l2(div_fn(state))
             return nu_plate, nu_vol, re, dnorm
 
         return observables
@@ -424,18 +420,12 @@ class Navier2D(Integrate):
         self.time += self.dt
 
     def update_n(self, n: int) -> None:
-        """Advance n steps on the device via scanned chunks.
+        """Advance n steps on the device via scanned power-of-two chunks
+        (utils/jit.run_scanned)."""
+        from ..utils.jit import run_scanned
 
-        Chunks are power-of-two buckets so arbitrary n costs at most
-        log2(n) distinct XLA compilations ever (a direct static-n scan would
-        recompile for every new chunk length, e.g. the tail of an integrate
-        interval)."""
-        remaining = int(n)
         with self._scope():
-            while remaining > 0:
-                bucket = 1 << (remaining.bit_length() - 1)
-                self.state = self._step_n(self.state, bucket)
-                remaining -= bucket
+            self.state = run_scanned(self._step_n, self.state, n)
         self.time += n * self.dt
 
     def get_time(self) -> float:

@@ -1,0 +1,159 @@
+"""Running-average flow statistics with HDF5 persistence.
+
+TPU rebuild of /root/reference/src/navier_stokes/statistics.rs: spectral-space
+running averages of temperature and velocities plus the pointwise Nusselt
+field, updated with the reference's ``(avg*n + new) / (n+1)`` weighting
+(statistics.rs:84-108) and persisted in the reference's layout — groups
+``{temp,ux,uy,nusselt}/{x,dx,y,dy,v,vhat}`` plus scalars
+``tot_time/avg_time/num_save`` and the physics params (statistics.rs:119-167).
+
+Two deliberate fixes over the reference:
+
+* the reference's ``update`` only running-averages ``t_avg`` and *overwrites*
+  ``ux_avg``/``uy_avg``/``nusselt`` with the instantaneous fields
+  (statistics.rs:98-104) despite their names; here all four carry the running
+  average,
+* the pointwise Nusselt field includes the temperature BC lift (the reference
+  feeds the homogeneous part only, navier_io.rs:110-115, which drops the
+  conduction contribution), so its volume average is consistent with
+  ``eval_nuvol``.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from .. import config
+
+
+class Statistics:
+    """Attach via ``model.statistics = Statistics(model, save_stat, write_stat)``;
+    the integrate callback then updates every ``save_stat`` and writes
+    ``data/statistics.h5`` every ``write_stat`` time units
+    (utils/navier_io.py)."""
+
+    def __init__(self, model, save_stat: float, write_stat: float):
+        self.save_stat = save_stat
+        self.write_stat = write_stat
+        self.space = model.field_space
+        self.scale = model.scale
+        self.params = dict(model.params)
+        shape = self.space.shape_spectral
+        dtype = self.space.spectral_dtype()
+        zeros = np.zeros(shape, dtype=dtype)
+        self.t_avg = zeros.copy()
+        self.ux_avg = zeros.copy()
+        self.uy_avg = zeros.copy()
+        self.nusselt = zeros.copy()
+        self.avg_time = 0.0
+        self.tot_time = float(model.time)
+        self.num_save = 0
+        self._nusselt_fn = self._make_nusselt(model)
+
+    def _make_nusselt(self, model):
+        """Pointwise-Nusselt field: 2*sy*(uy*T/ka - dT/dy/sy) in the
+        scratch-ortho space, dealiased (statistics.rs:246-270).  Runs eagerly:
+        updates are save-interval-rare, and jitting would re-embed the large
+        transform constants the model deliberately hoists (utils/jit.py)."""
+        sp = self.space
+        scale = self.scale
+        ka = self.params["ka"]
+        mask = model._dealias
+
+        def nusselt_field(that, uxhat, uyhat):
+            del uxhat  # reference signature; only uy and T enter the flux
+            temp_p = sp.backward_ortho(that)
+            uy_p = sp.backward_ortho(uyhat)
+            dtdz = sp.backward_ortho(sp.gradient(that, (0, 1), None)) / (-scale[1])
+            nu_v = (dtdz + uy_p * temp_p / ka) * 2.0 * scale[1]
+            return sp.forward(nu_v) * mask
+
+        return nusselt_field
+
+    def update(self, model) -> None:
+        """Fold the model's current state into the running averages
+        (statistics.rs:84-108)."""
+        time = float(model.time)
+        if time < self.tot_time:
+            print(f"Statistics time mismatch (navier < stat): {time} < {self.tot_time}")
+            return
+        with model._scope():
+            that_h = model.temp_space.to_ortho(model.state.temp)
+            uxhat = model.velx_space.to_ortho(model.state.velx)
+            uyhat = model.vely_space.to_ortho(model.state.vely)
+            nu_hat = self._nusselt_fn(that_h + model.tempbc_ortho, uxhat, uyhat)
+        w = float(self.num_save)
+        for attr, new in (
+            ("t_avg", that_h),
+            ("ux_avg", uxhat),
+            ("uy_avg", uyhat),
+            ("nusselt", nu_hat),
+        ):
+            avg = getattr(self, attr)
+            setattr(self, attr, (avg * w + np.asarray(new)) / (w + 1.0))
+        self.num_save += 1
+        self.avg_time += time - self.tot_time
+        self.tot_time = time
+
+    # -- IO ------------------------------------------------------------------
+
+    _MEMBERS = (("temp", "t_avg"), ("ux", "ux_avg"), ("uy", "uy_avg"), ("nusselt", "nusselt"))
+
+    def write(self, filename: str) -> None:
+        """statistics.rs:140-158 layout."""
+        import h5py
+
+        from ..utils.checkpoint import write_field
+        from ..field import grid_deltas
+
+        os.makedirs(os.path.dirname(filename) or ".", exist_ok=True)
+        xs = [b.points * s for b, s in zip(self.space.bases, self.scale)]
+        dxs = [
+            grid_deltas(b.points, b.is_periodic) * s
+            for b, s in zip(self.space.bases, self.scale)
+        ]
+        with h5py.File(filename, "a") as h5:
+            for varname, attr in self._MEMBERS:
+                vhat = jax_asarray(getattr(self, attr), self.space)
+                write_field(h5, varname, self.space, vhat, xs, dxs)
+            for key, value in (
+                ("tot_time", self.tot_time),
+                ("avg_time", self.avg_time),
+                ("num_save", float(self.num_save)),
+            ):
+                if key in h5:
+                    del h5[key]
+                h5.create_dataset(key, data=value)
+            for key, value in self.params.items():
+                if key in h5:
+                    del h5[key]
+                h5.create_dataset(key, data=float(value))
+
+    def read(self, filename: str) -> None:
+        """statistics.rs:119-134: restore averages + counters."""
+        import h5py
+
+        from ..utils.checkpoint import read_field_vhat
+
+        with h5py.File(filename, "r") as h5:
+            for varname, attr in self._MEMBERS:
+                setattr(
+                    self,
+                    attr,
+                    read_field_vhat(h5, varname, self.space).astype(
+                        self.space.spectral_dtype()
+                    ),
+                )
+            self.tot_time = float(np.asarray(h5["tot_time"]))
+            self.avg_time = float(np.asarray(h5["avg_time"]))
+            self.num_save = int(np.asarray(h5["num_save"]))
+        print(f" <== {filename}")
+
+
+def jax_asarray(arr, space):
+    """Device array in the space's spectral dtype (host numpy accepted)."""
+    import jax.numpy as jnp
+
+    return jnp.asarray(arr, dtype=space.spectral_dtype())

@@ -20,6 +20,19 @@ import jax.extend.core as jex_core
 import jax.numpy as jnp
 
 
+def run_scanned(step_n, state, n: int):
+    """Advance ``n`` steps through ``step_n(state, bucket)`` in power-of-two
+    buckets, so arbitrary ``n`` costs at most log2(n) distinct XLA
+    compilations ever (a direct static-n scan would recompile for every new
+    chunk length, e.g. the tail of an integrate interval)."""
+    remaining = int(n)
+    while remaining > 0:
+        bucket = 1 << (remaining.bit_length() - 1)
+        state = step_n(state, bucket)
+        remaining -= bucket
+    return state
+
+
 def hoist_constants(fn, *example):
     """Return ``(converted, consts)`` where ``converted(consts, *args)``
     computes ``fn(*args)`` with every captured constant passed explicitly.

@@ -18,6 +18,7 @@ def callback(
     flowname: str | None = None,
     io_name: str = "data/info.txt",
     suppress_io: bool = False,
+    extra: str | None = None,
 ) -> None:
     t = model.get_time()
     dt = model.get_dt()
@@ -48,10 +49,13 @@ def callback(
     if suppress_io:
         return
     nu, nuvol, re, div = model.get_observables()
-    print(
+    line = (
         f"time = {t:9.3f}      |div| = {div:4.2e}      "
         f"Nu = {nu:5.3e}      Nuv = {nuvol:5.3e}      Re = {re:5.3e}"
     )
+    if extra:
+        line += f"      {extra}"
+    print(line)
     try:
         with open(io_name, "a", encoding="utf-8") as fh:
             fh.write(f"{t} {nu} {nuvol} {re}\n")

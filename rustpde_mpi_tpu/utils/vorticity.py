@@ -1,0 +1,67 @@
+"""Vorticity post-processing: append ``omega = dv/dx - du/dy`` to snapshots.
+
+TPU rebuild of /root/reference/src/navier_stokes/vorticity.rs:40-81: read the
+velocity fields from a flow snapshot, compute the vorticity in spectral
+space, dealias (2/3 rule), and append ``vorticity/{v,vhat}`` to the same
+file.  The confined/periodic configuration is auto-detected from the stored
+spectral dtype (complex datasets => periodic x-axis), with explicit
+functions matching the reference's pair.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..bases import Space2, cheb_dirichlet, chebyshev, fourier_r2c
+from ..models import functions as fns
+from .checkpoint import _write_array, read_field_vhat
+
+
+def vorticity_from_file(fname: str) -> None:
+    """Confined variant (vorticity.rs:40-57)."""
+    _vorticity(fname, periodic=False)
+
+
+def vorticity_from_file_periodic(fname: str) -> None:
+    """Periodic-x variant (vorticity.rs:65-81)."""
+    _vorticity(fname, periodic=True)
+
+
+def vorticity_auto(fname: str) -> None:
+    """Detect the configuration from the snapshot itself."""
+    import h5py
+
+    with h5py.File(fname, "r") as h5:
+        periodic = "ux/vhat_re" in h5
+    _vorticity(fname, periodic=periodic)
+
+
+def _vorticity(fname: str, periodic: bool) -> None:
+    import h5py
+
+    with h5py.File(fname, "r") as h5:
+        nx = h5["ux/x"].shape[0]
+        ny = h5["ux/y"].shape[0]
+        x_base = fourier_r2c if periodic else cheb_dirichlet
+        x_full = fourier_r2c if periodic else chebyshev
+        vel_space = Space2(x_base(nx), cheb_dirichlet(ny))
+        vort_space = Space2(x_full(nx), chebyshev(ny))
+        uxhat = read_field_vhat(h5, "ux", vel_space)
+        uyhat = read_field_vhat(h5, "uy", vel_space)
+    import jax.numpy as jnp
+
+    uxhat = jnp.asarray(uxhat, dtype=vel_space.spectral_dtype())
+    uyhat = jnp.asarray(uyhat, dtype=vel_space.spectral_dtype())
+    dudz = vel_space.gradient(uxhat, (0, 1), (1.0, 1.0))
+    dvdx = vel_space.gradient(uyhat, (1, 0), (1.0, 1.0))
+    vort = dvdx - dudz
+    mask = jnp.asarray(
+        fns.dealias_mask(vort_space.shape_spectral), dtype=vort.real.dtype
+    )
+    vort = vort * mask
+    v = np.asarray(vort_space.backward_ortho(vort))
+
+    with h5py.File(fname, "a") as h5:
+        grp = h5.require_group("vorticity")
+        _write_array(grp, "v", v)
+        _write_array(grp, "vhat", np.asarray(vort))

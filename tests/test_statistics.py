@@ -1,0 +1,122 @@
+"""Statistics + vorticity post-processing tests (SURVEY.md S2 rows
+`statistics`, `vorticity`)."""
+
+import numpy as np
+import pytest
+
+from rustpde_mpi_tpu import Navier2D, Statistics, integrate, vorticity_auto
+
+h5py = pytest.importorskip("h5py")
+
+
+def _model(periodic=False, nx=16):
+    model = Navier2D(
+        nx if periodic else 17, 17, 1e4, 1.0, 0.01, 1.0, "rbc", periodic=periodic
+    )
+    model.set_velocity(0.1, 1.0, 1.0)
+    model.set_temperature(0.1, 1.0, 1.0)
+    return model
+
+
+def test_running_average_weighting():
+    """(avg*n + new)/(n+1): after updates at two different states, the
+    average equals the mean of the sampled fields (statistics.rs:84-108)."""
+    model = _model()
+    stats = Statistics(model, save_stat=0.01, write_stat=1.0)
+
+    samples = []
+    for _ in range(3):
+        model.update_n(5)
+        with model._scope():
+            samples.append(np.asarray(model.temp_space.to_ortho(model.state.temp)))
+        stats.update(model)
+    assert stats.num_save == 3
+    np.testing.assert_allclose(stats.t_avg, np.mean(samples, axis=0), atol=1e-13)
+    # created at t=0, so the averaging window spans the whole run
+    assert stats.avg_time == pytest.approx(model.time)
+    assert stats.tot_time == pytest.approx(model.time)
+
+
+def test_update_ignores_time_regression():
+    model = _model()
+    stats = Statistics(model, 0.01, 1.0)
+    model.update_n(5)
+    stats.update(model)
+    n = stats.num_save
+    model.time -= 1.0  # simulate a mismatched restart
+    stats.update(model)
+    assert stats.num_save == n  # rejected, like the reference
+
+
+def test_statistics_write_read_roundtrip(tmp_path):
+    model = _model()
+    stats = Statistics(model, 0.01, 1.0)
+    model.update_n(10)
+    stats.update(model)
+    fname = str(tmp_path / "statistics.h5")
+    stats.write(fname)
+
+    with h5py.File(fname, "r") as h5:
+        for var in ("temp", "ux", "uy", "nusselt"):
+            for ds in ("x", "y", "v", "vhat"):
+                assert f"{var}/{ds}" in h5
+        for key in ("tot_time", "avg_time", "num_save", "ra", "ka"):
+            assert key in h5
+
+    other = Statistics(model, 0.01, 1.0)
+    other.read(fname)
+    assert other.num_save == stats.num_save
+    assert other.tot_time == pytest.approx(stats.tot_time)
+    np.testing.assert_allclose(other.t_avg, stats.t_avg, atol=1e-14)
+    np.testing.assert_allclose(other.nusselt, stats.nusselt, atol=1e-14)
+
+
+def test_nusselt_field_volume_average_matches_nuvol():
+    """The volume average of the pointwise Nusselt field equals eval_nuvol
+    (same integrand) for a single-sample average."""
+    model = _model()
+    model.update_n(20)
+    stats = Statistics(model, 0.01, 1.0)
+    stats.update(model)
+    sp = model.field_space
+    nu_v = np.asarray(sp.backward_ortho(np.asarray(stats.nusselt)))
+    w0 = np.asarray(model._w0)
+    w1 = np.asarray(model._w1)
+    vol_avg = float((nu_v * w0[:, None] * w1[None, :]).sum())
+    # dealiasing of the stored field perturbs the mean slightly
+    assert vol_avg == pytest.approx(model.eval_nuvol(), rel=2e-2, abs=1e-3)
+
+
+def test_callback_integration_writes_statistics(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    model = _model()
+    model.statistics = Statistics(model, save_stat=0.05, write_stat=0.1)
+    model.write_intervall = 10.0  # suppress flow snapshots
+    integrate(model, 0.2, save_intervall=0.05)
+    assert model.statistics.num_save >= 3
+    assert (tmp_path / "data" / "statistics.h5").exists()
+
+
+@pytest.mark.parametrize("periodic", [False, True])
+def test_vorticity_appends_to_snapshot(tmp_path, periodic):
+    model = _model(periodic=periodic)
+    model.update_n(10)
+    fname = str(tmp_path / "flow.h5")
+    model.write(fname)
+    vorticity_auto(fname)
+    with h5py.File(fname, "r") as h5:
+        assert "vorticity/v" in h5
+        vort = np.asarray(h5["vorticity/v"])
+    assert vort.shape == model.field_space.shape_physical
+    assert np.all(np.isfinite(vort))
+    # cross-check against a direct spectral computation of dv/dx - du/dy
+    with model._scope():
+        dvdx = model.vely_space.gradient(model.state.vely, (1, 0), (1.0, 1.0))
+        dudz = model.velx_space.gradient(model.state.velx, (0, 1), (1.0, 1.0))
+        direct = np.asarray(model.field_space.backward_ortho(dvdx - dudz))
+    # stored field is dealiased; compare on the interior spectrum via a loose
+    # physical-space tolerance
+    assert np.abs(vort - direct).max() / max(np.abs(direct).max(), 1e-30) < 0.2
+    # tiny test grids lose a visible spectral fraction to the 2/3 cut, so the
+    # correlation bound is loose; the shape comparison is the real check
+    assert np.corrcoef(vort.ravel(), direct.ravel())[0, 1] > 0.99

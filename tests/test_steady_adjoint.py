@@ -1,0 +1,81 @@
+"""Navier2DAdjoint steady-state finder tests (SURVEY.md S2 row
+`Navier2DAdjoint`; /root/reference/src/navier_stokes/steady_adjoint.rs)."""
+
+import numpy as np
+import pytest
+
+from rustpde_mpi_tpu import Navier2D, Navier2DAdjoint
+from rustpde_mpi_tpu.models.steady_adjoint import DT_NAVIER
+
+
+def _adjoint(nx=33, ra=1e4, dt=5e-3, bc="rbc"):
+    model = Navier2DAdjoint.new_confined(nx, nx, ra, 1.0, dt, 1.0, bc)
+    model.set_temperature(0.5, 1.0, 1.0)
+    model.set_velocity(0.5, 1.0, 1.0)
+    return model
+
+
+def test_residual_decreases():
+    model = _adjoint()
+    model.update_n(50)
+    res_early = model.residual()
+    model.update_n(450)
+    assert model.residual() < res_early
+    assert np.isfinite(model.div_norm())
+
+
+def test_subcritical_converges_to_conduction():
+    """Ra=100 << Ra_c from zero fields: the descent settles into the
+    conduction state (hydrostatic pressure builds over the first iterations),
+    the residual drops below RES_TOL, exit() fires, and Nu -> 1."""
+    model = Navier2DAdjoint.new_confined(17, 17, 100.0, 1.0, 1e-3, 1.0, "rbc")
+    converged = False
+    for _ in range(5):
+        model.update_n(200)
+        if model.exit():
+            converged = True
+            break
+    assert converged, f"residual {model.residual()} after 1000 iterations"
+    assert model.residual() < 1e-7
+    assert model.eval_nu() == pytest.approx(1.0, abs=1e-4)
+
+
+def test_supercritical_descends_toward_steady_state():
+    """Ra=5e3 > Ra_c: the residual decreases monotonically-ish and the state
+    approaches a convective steady state whose forward-DNS Nu drift is small.
+    (Full convergence to RES_TOL is exercised by examples/navier_rbc_steady.py
+    — it takes tens of thousands of iterations.)"""
+    model = _adjoint(nx=17, ra=5e3, dt=1e-2)
+    model.update_n(300)
+    res_early = model.residual()
+    model.update_n(1200)
+    res = model.residual()
+    assert res < res_early
+    assert res < 1e-2
+    nu_adj = model.eval_nu()
+    assert 1.0 < nu_adj < 3.0
+
+    # forward DNS check: the near-steady state should evolve only slowly
+    dns = Navier2D(17, 17, 5e3, 1.0, DT_NAVIER, 1.0, "rbc", periodic=False)
+    dns.state = dns.state._replace(
+        temp=model.state.temp,
+        velx=model.state.velx,
+        vely=model.state.vely,
+        pres=model.state.pres,
+        pseu=model.state.pseu,
+    )
+    nu0 = dns.eval_nu()
+    dns.update_n(500)
+    assert dns.eval_nu() == pytest.approx(nu0, rel=5e-2)
+
+
+def test_write_read_roundtrip(tmp_path):
+    model = _adjoint(nx=17)
+    model.update_n(10)
+    fname = str(tmp_path / "adjoint.h5")
+    model.write(fname)
+    other = _adjoint(nx=17)
+    other.read(fname)
+    np.testing.assert_allclose(
+        np.asarray(other.state.temp), np.asarray(model.state.temp), atol=1e-14
+    )
