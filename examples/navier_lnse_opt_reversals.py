@@ -1,0 +1,124 @@
+"""Optimal-perturbation campaign: gradient-based search for disturbances
+that trigger flow reversals.
+
+Port of /root/reference/examples/navier_lnse_opt_reversals.rs:24-80: find a
+large-scale-circulation base state with the DNS, build its mirrored state as
+the optimization target, then iterate energy-constrained steepest descent on
+the initial perturbation using the adjoint gradient of the final-time
+distance to the target.
+
+Usage:  python examples/navier_lnse_opt_reversals.py [--quick]
+  --quick shrinks the grid/horizons so the whole campaign runs in ~a minute.
+"""
+
+import sys
+
+sys.path.insert(0, ".")
+
+import numpy as np  # noqa: E402
+
+from rustpde_mpi_tpu import (  # noqa: E402
+    MeanFields,
+    Navier2D,
+    Navier2DNonLin,
+    integrate,
+    steepest_descent_energy_constrained,
+)
+from rustpde_mpi_tpu.models.lnse import l2_norm  # noqa: E402
+
+
+def mirror_field(velx, vely, temp):
+    """x-mirrored LSC state (the reversed circulation)
+    (navier_lnse_opt_reversals.rs:7-13)."""
+    return -velx[::-1, :], -vely.copy(), temp[::-1, :]
+
+
+def find_base_field(nx, ny, dt, ra, pr, aspect, max_time):
+    model = Navier2D.new_confined(nx, ny, ra, pr, dt, aspect, "rbc")
+    model.init_random(1e-3)
+    model.write_intervall = max_time * 10
+    integrate(model, max_time, save_intervall=max_time)
+    return model
+
+
+def main() -> int:
+    quick = "--quick" in sys.argv
+    nx, ny = (24, 21) if quick else (128, 57)
+    ra, pr, aspect = 1e5, 1.0, 1.0
+    dt = 0.02
+    base_time = 20.0 if quick else 300.0
+    max_iter = 3 if quick else 30
+    horizons = [5.0] if quick else np.linspace(5.0, 50.0, 5)
+    energies = [1e-4] if quick else np.logspace(10.0, 0.0, 7) / 1e10
+    alpha_0 = 1.0
+    beta1 = beta2 = 0.5
+
+    base = find_base_field(nx, ny, dt, ra, pr, aspect, base_time)
+    base.write("data/mean.h5")
+    mean = MeanFields.read_from(nx, ny, "data/mean.h5", bc="rbc")
+
+    # target: mirrored base state, expressed as a perturbation about the mean
+    mu, mv, mt = mean.physical()
+    tu, tv, tt = mirror_field(mu, mv, mt)
+    target = MeanFields(mean.space)
+    target.velx = mean.space.forward(np.asarray(tu - mu))
+    target.vely = mean.space.forward(np.asarray(tv - mv))
+    target.temp = mean.space.forward(np.asarray(tt - mt))
+
+    for max_time in horizons:
+        for e_constraint in energies:
+            print(f"MAX TIME {max_time}  ENERGY {e_constraint:.2e}")
+            model = Navier2DNonLin.new_confined(
+                nx, ny, ra, pr, dt, aspect, "rbc", mean=mean
+            )
+            model.init_random(1e-3)
+            # scale IC to the energy constraint
+            u, v, t = (np.asarray(a) for a in model._phys(model.state))
+            e0 = float(l2_norm(u, u, v, v, t, t, beta1, beta2)) / u.size
+            fac = np.sqrt(e_constraint / e0)
+            model.set_field("velx", u * fac)
+            model.set_field("vely", v * fac)
+            model.set_field("temp", t * fac)
+
+            best = np.inf
+            alpha = alpha_0
+            j_old = 0.0
+            for it in range(max_iter):
+                # fresh pressure every iteration
+                # (navier_lnse_opt_reversals.rs:127-131)
+                import jax.numpy as jnp
+
+                model.state = model.state._replace(
+                    pres=jnp.zeros_like(model.state.pres),
+                    pseu=jnp.zeros_like(model.state.pseu),
+                )
+                model.reset_time()
+                u0, v0, t0 = (np.asarray(a) for a in model._phys(model.state))
+                fun_val, grads = model.grad_adjoint(
+                    max_time, None, beta1, beta2, target=target
+                )
+                # backtracking step control (navier_lnse_opt_reversals.rs:143-152)
+                if it > 0 and fun_val > j_old:
+                    alpha /= 2.0
+                    print(f"  set alpha: {alpha:4.2e}")
+                    if alpha < 1e-3:
+                        print("  alpha too small. Reset")
+                        alpha = alpha_0
+                j_old = fun_val
+                print(f"  iter {it}: J = {fun_val:.6e}  alpha = {alpha:.3f}")
+                best = min(best, fun_val)
+                gu, gv, gt = (np.asarray(g) for g in grads)
+                un, vn, tn = steepest_descent_energy_constrained(
+                    u0, v0, t0, gu, gv, gt, beta1, beta2, alpha
+                )
+                model.reset_time()
+                model.set_field("velx", un)
+                model.set_field("vely", vn)
+                model.set_field("temp", tn)
+            print(f"  best J = {best:.6e}")
+    print("OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

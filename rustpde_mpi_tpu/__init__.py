@@ -24,7 +24,10 @@ from .bases import (  # noqa: F401
     fourier_r2c,
 )
 from .field import Field2, average, average_axis, norm_l2  # noqa: F401
+from .models.lnse import Navier2DLnse, Navier2DNonLin  # noqa: F401
+from .models.meanfield import MeanFields  # noqa: F401
 from .models.navier import Navier2D, NavierState  # noqa: F401
+from .models.opt_routines import steepest_descent_energy_constrained  # noqa: F401
 from .models.statistics import Statistics  # noqa: F401
 from .models.steady_adjoint import Navier2DAdjoint  # noqa: F401
 from .utils.integrate import Integrate, integrate  # noqa: F401

@@ -1,5 +1,8 @@
 """Physics model layer: Navier-Stokes DNS and derived solvers."""
 
+from .lnse import Navier2DLnse, Navier2DNonLin  # noqa: F401
+from .meanfield import MeanFields  # noqa: F401
 from .navier import Navier2D, NavierState  # noqa: F401
+from .opt_routines import steepest_descent_energy_constrained  # noqa: F401
 from .statistics import Statistics  # noqa: F401
 from .steady_adjoint import Navier2DAdjoint  # noqa: F401

@@ -1,0 +1,134 @@
+"""MeanFields — base-state container for the linearized/perturbation solvers.
+
+Rebuild of /root/reference/src/navier_stokes_lnse/meanfield.rs:26-121: the
+velx/vely/temp base state in the full orthogonal space (chebyshev^2 confined,
+fourier x chebyshev periodic), with built-in RBC (linear conduction profile)
+and HC (cos-bottom parabola) constructors and a read-from-file variant that
+falls back to the analytic profile when the file is missing.
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax.numpy as jnp
+import numpy as np
+
+from .. import config
+from ..bases import Space2, chebyshev, fourier_r2c
+
+
+class MeanFields:
+    """velx/vely/temp spectral coefficients on the full ortho space."""
+
+    def __init__(self, space: Space2, velx=None, vely=None, temp=None):
+        self.space = space
+        zero = space.ndarray_spectral()
+        self.velx = zero if velx is None else velx
+        self.vely = zero if vely is None else vely
+        self.temp = zero if temp is None else temp
+
+    # -- constructors (meanfield.rs:27-90, 133-207) --------------------------
+
+    @classmethod
+    def _space(cls, nx: int, ny: int, periodic: bool) -> Space2:
+        x_base = fourier_r2c if periodic else chebyshev
+        return Space2(x_base(nx), chebyshev(ny))
+
+    @classmethod
+    def new_rbc(cls, nx: int, ny: int, periodic: bool = False) -> "MeanFields":
+        """Linear conduction profile T = 0.5 at the bottom to -0.5 at the top."""
+        space = cls._space(nx, ny, periodic)
+        y = space.bases[1].points
+        height = y[-1] - y[0]
+        profile = -(y - y[0]) / height + 0.5
+        v = np.broadcast_to(profile[None, :], space.shape_physical)
+        temp = space.forward(jnp.asarray(v, dtype=config.real_dtype()))
+        return cls(space, temp=temp)
+
+    @classmethod
+    def new_hc(cls, nx: int, ny: int, periodic: bool = False) -> "MeanFields":
+        """Horizontal convection: T = -0.5 cos(2 pi x~) at the bottom,
+        parabola in y with vertex at the top wall."""
+        space = cls._space(nx, ny, periodic)
+        x = space.bases[0].points
+        y = space.bases[1].points
+        f_x = -0.5 * np.cos(2.0 * np.pi * (x - x[0]) / (x[-1] - x[0]))
+        a = f_x / (y[0] - y[-1]) ** 2
+        v = a[:, None] * (y[None, :] - y[-1]) ** 2
+        temp = space.forward(jnp.asarray(v, dtype=config.real_dtype()))
+        return cls(space, temp=temp)
+
+    @classmethod
+    def read_from(
+        cls, nx: int, ny: int, filename: str, bc: str | None = None, periodic: bool = False
+    ) -> "MeanFields":
+        """Read a mean field from a flow snapshot; fall back to the analytic
+        bc profile when the file does not exist (meanfield.rs:92-121)."""
+        if os.path.isfile(filename):
+            mean = cls(cls._space(nx, ny, periodic))
+            mean.read(filename)
+            return mean
+        print(f"File {filename!r} does not exist. Use {bc!r} meanfield.")
+        if bc == "hc":
+            return cls.new_hc(nx, ny, periodic)
+        return cls.new_rbc(nx, ny, periodic)
+
+    # -- IO (reference snapshot layout, vars ux/uy/temp) ---------------------
+
+    _VARS = (("ux", "velx"), ("uy", "vely"), ("temp", "temp"))
+
+    def read(self, filename: str) -> None:
+        """Read the base state from a flow snapshot.
+
+        Deliberate fix over the reference: its MeanFields read assigns the
+        snapshot's *composite* (e.g. cheb_dirichlet) coefficients into the
+        mean's *orthogonal* space via the shape-mismatch zero-pad
+        (meanfield.rs:92-106 + field/io.rs:74-83), which misinterprets the
+        Galerkin coefficients.  Here the stored physical values ``{var}/v``
+        are forward-transformed in the ortho space — exact for any source
+        space.  Falls back to ``vhat`` if ``v`` is absent (then the source
+        must be ortho-space data, e.g. one written by this class)."""
+        import h5py
+
+        from ..utils.checkpoint import read_field_vhat
+
+        rdt = config.real_dtype()
+        with h5py.File(filename, "r") as h5:
+            for varname, attr in self._VARS:
+                if f"{varname}/v" in h5:
+                    v = np.asarray(h5[f"{varname}/v"])
+                    if v.shape != self.space.shape_physical:
+                        raise ValueError(
+                            f"{varname}/v shape {v.shape} != grid "
+                            f"{self.space.shape_physical}; resample the "
+                            "snapshot first"
+                        )
+                    vhat = self.space.forward(jnp.asarray(v, dtype=rdt))
+                else:
+                    vhat = jnp.asarray(
+                        read_field_vhat(h5, varname, self.space),
+                        dtype=self.space.spectral_dtype(),
+                    )
+                setattr(self, attr, vhat)
+        print(f" <== {filename}")
+
+    def write(self, filename: str) -> None:
+        import h5py
+
+        from ..field import grid_deltas
+        from ..utils.checkpoint import write_field
+
+        os.makedirs(os.path.dirname(filename) or ".", exist_ok=True)
+        xs = [b.points for b in self.space.bases]
+        dxs = [grid_deltas(b.points, b.is_periodic) for b in self.space.bases]
+        with h5py.File(filename, "a") as h5:
+            for varname, attr in self._VARS:
+                write_field(h5, varname, self.space, getattr(self, attr), xs, dxs)
+
+    def physical(self) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+        return (
+            np.asarray(self.space.backward_ortho(self.velx)),
+            np.asarray(self.space.backward_ortho(self.vely)),
+            np.asarray(self.space.backward_ortho(self.temp)),
+        )

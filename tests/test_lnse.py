@@ -1,0 +1,190 @@
+"""LNSE / NonLin / adjoint-gradient stack tests (SURVEY.md S2 rows
+`Navier2DLnse`, `lnse_adj_grad`, `lnse_fd_grad`, `Navier2DNonLin`,
+`meanfield`, `opt_routines`)."""
+
+import numpy as np
+import pytest
+
+from rustpde_mpi_tpu import (
+    MeanFields,
+    Navier2D,
+    Navier2DLnse,
+    Navier2DNonLin,
+    steepest_descent_energy_constrained,
+)
+from rustpde_mpi_tpu.models.lnse import l2_norm
+
+
+def _norm(arrs):
+    return np.sqrt(sum(float(np.sum(np.asarray(a) ** 2)) for a in arrs))
+
+
+def _lnse(nx=14, ny=11, ra=3e3, pr=0.1, dt=0.01, cls=Navier2DLnse, seed=1):
+    model = cls.new_confined(nx, ny, ra, pr, dt, 1.0, "rbc", mean=MeanFields.new_rbc(nx, ny))
+    model.init_random(1e-3, seed=seed)
+    return model
+
+
+# -- linear stability physics -------------------------------------------------
+
+
+def test_lnse_subcritical_perturbations_decay():
+    """About the conduction state below Ra_c ~ 1708 every perturbation decays."""
+    model = _lnse(ra=1000.0)
+    e0 = model.energy(0.5, 0.5)
+    model.update_n(400)
+    assert model.energy(0.5, 0.5) < 0.5 * e0
+
+
+def test_lnse_supercritical_perturbations_grow():
+    """Above onset the linearized operator has an unstable mode: after the
+    random-noise transient decays (t < ~6), the leading eigenmode grows
+    exponentially (measured ~x2 per 2 time units at Ra=1e4)."""
+    model = _lnse(nx=17, ny=17, ra=1e4, pr=1.0)
+    model.update_n(800)  # past the transient
+    e_mid = model.energy(0.5, 0.5)
+    model.update_n(400)
+    assert model.energy(0.5, 0.5) > 2.0 * e_mid
+
+
+# -- NonLin equivalence -------------------------------------------------------
+
+
+def test_nonlin_with_conduction_mean_equals_navier2d():
+    """The perturbation form about the conduction profile must reproduce the
+    full DNS exactly (mean convection/diffusion terms == the bc lift terms)."""
+    nx = ny = 17
+    nav = Navier2D(nx, ny, 1e4, 1.0, 0.01, 1.0, "rbc", periodic=False)
+    nav.set_velocity(0.1, 1.0, 1.0)
+    nav.set_temperature(0.1, 1.0, 1.0)
+    nl = Navier2DNonLin.new_confined(
+        nx, ny, 1e4, 1.0, 0.01, 1.0, "rbc", mean=MeanFields.new_rbc(nx, ny)
+    )
+    for name in ("velx", "vely", "temp"):
+        nl.set_field(name, nav.get_field(name))
+    nav.update_n(50)
+    nl.update_n(50)
+    for name in ("temp", "velx", "vely"):
+        np.testing.assert_allclose(
+            np.asarray(getattr(nl.state, name)),
+            np.asarray(getattr(nav.state, name)),
+            atol=1e-13,
+        )
+
+
+# -- gradients ---------------------------------------------------------------
+
+
+@pytest.mark.parametrize("cls", [Navier2DLnse, Navier2DNonLin])
+def test_autodiff_gradient_matches_directional_fd(cls):
+    """jax.grad through the scanned forward loop is the exact gradient of the
+    discrete objective: central-difference directional derivative matches to
+    ~1e-6 (vs the reference's 30% hand-adjoint tolerance,
+    examples/navier_lnse_test_gradient.rs:33-50)."""
+    model = _lnse(cls=cls)
+    _, grads = model.grad_autodiff(0.5, 0.5, 0.5)
+    u0, v0, t0 = (np.asarray(a) for a in model._phys(model.state))
+    objective = model._objective_fn(50, 0.5, 0.5, None)
+    rng = np.random.default_rng(0)
+    dirs = [rng.standard_normal(a.shape) for a in (u0, v0, t0)]
+    eps = 1e-6
+    jp = float(objective(*[a + eps * d for a, d in zip((u0, v0, t0), dirs)]))
+    jm = float(objective(*[a - eps * d for a, d in zip((u0, v0, t0), dirs)]))
+    fd = (jp - jm) / (2 * eps)
+    # grads are the descent direction -dJ/du (MAXIMIZE=False)
+    ad = -sum(float(np.sum(g * d)) for g, d in zip(grads, dirs))
+    assert ad == pytest.approx(fd, rel=1e-5)
+
+
+def test_fd_gradient_matches_autodiff_pointwise():
+    """The ported brute-force FD gradient (vmapped) agrees with autodiff."""
+    model = _lnse(nx=10, ny=9)
+    ic = model.state
+    _, g_auto = model.grad_autodiff(0.2, 0.5, 0.5)
+    model.state = ic
+    model.reset_time()
+    g_fd = model.grad_fd(0.2, 0.5, 0.5, eps=1e-5)
+    # forward differences at eps=1e-5 on a ~1e-9 objective: modest tolerance
+    for ga, gf in zip(g_auto, g_fd):
+        num = np.sqrt(np.sum((np.asarray(gf) - (-np.asarray(ga))) ** 2))
+        den = max(np.sqrt(np.sum(np.asarray(gf) ** 2)), 1e-300)
+        assert num / den < 1e-2
+
+
+@pytest.mark.parametrize("cls", [Navier2DLnse, Navier2DNonLin])
+def test_hand_adjoint_gradient_agreement(cls):
+    """Port of the reference's adjoint-vs-FD validation
+    (examples/navier_lnse_test_gradient.rs, rel-tol 0.3): the hand adjoint is
+    a continuous-adjoint approximation; against the *exact* discrete gradient
+    its error is config/seed dependent (measured 0.35-0.50 here, flat in dt),
+    so the gate is 0.6 with the direction check as the real assertion."""
+    model = _lnse(cls=cls)
+    ic = model.state
+    val_a, g_auto = model.grad_autodiff(1.0, 0.5, 0.5)
+    model.state = ic
+    model.reset_time()
+    val_h, g_hand = model.grad_adjoint(1.0, None, 0.5, 0.5)
+    # identical forward loops -> identical objective values
+    assert val_h == pytest.approx(val_a, rel=1e-10)
+    rel = _norm([a - b for a, b in zip(g_auto, g_hand)]) / _norm(g_auto)
+    assert rel < 0.6
+    # the approximate gradient must still be a descent direction
+    cos = sum(float(np.sum(a * b)) for a, b in zip(g_auto, g_hand))
+    cos /= _norm(g_auto) * _norm(g_hand)
+    assert cos > 0.7
+
+
+# -- optimization routine -----------------------------------------------------
+
+
+def test_steepest_descent_preserves_energy():
+    rng = np.random.default_rng(5)
+    shape = (12, 12)
+    u, v, t = (rng.standard_normal(shape) for _ in range(3))
+    gu, gv, gt = (rng.standard_normal(shape) for _ in range(3))
+    un, vn, tn = steepest_descent_energy_constrained(
+        u, v, t, gu, gv, gt, 0.5, 0.5, alpha=0.7
+    )
+    e0 = float(l2_norm(u, u, v, v, t, t, 0.5, 0.5))
+    e1 = float(l2_norm(un, un, vn, vn, tn, tn, 0.5, 0.5))
+    assert e1 == pytest.approx(e0, rel=1e-10)
+    with pytest.raises(ValueError):
+        steepest_descent_energy_constrained(u, v, t, gu, gv, gt, 0.5, 0.5, 7.0)
+
+
+# -- mean fields --------------------------------------------------------------
+
+
+def test_meanfields_profiles_and_roundtrip(tmp_path):
+    mean = MeanFields.new_rbc(14, 11)
+    _, _, t = mean.physical()
+    # linear profile from +0.5 (bottom) to -0.5 (top)
+    np.testing.assert_allclose(t[:, 0], 0.5, atol=1e-12)
+    np.testing.assert_allclose(t[:, -1], -0.5, atol=1e-12)
+
+    fname = str(tmp_path / "mean.h5")
+    mean.write(fname)
+    other = MeanFields.read_from(14, 11, fname)
+    np.testing.assert_allclose(
+        np.asarray(other.temp), np.asarray(mean.temp), atol=1e-12
+    )
+    # missing file falls back to the analytic profile
+    fallback = MeanFields.read_from(14, 11, str(tmp_path / "nope.h5"), bc="rbc")
+    np.testing.assert_allclose(
+        np.asarray(fallback.temp), np.asarray(mean.temp), atol=1e-12
+    )
+
+
+def test_meanfields_read_from_dns_snapshot(tmp_path):
+    """Reading a composite-space DNS snapshot reconstructs the physical
+    fields exactly (the reference's coefficient zero-pad would not)."""
+    nav = Navier2D(16, 17, 1e4, 1.0, 0.01, 1.0, "rbc", periodic=False)
+    nav.set_velocity(0.3, 1.0, 1.0)
+    nav.update_n(5)
+    fname = str(tmp_path / "mean.h5")
+    nav.write(fname)
+    mean = MeanFields.read_from(16, 17, fname)
+    u, v, t = mean.physical()
+    np.testing.assert_allclose(u, nav.get_field("velx"), atol=1e-12)
+    np.testing.assert_allclose(v, nav.get_field("vely"), atol=1e-12)
+    np.testing.assert_allclose(t, nav.get_field("temp"), atol=1e-12)
