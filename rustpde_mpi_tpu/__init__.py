@@ -22,6 +22,7 @@ from .bases import (  # noqa: F401
     chebyshev,
     fourier_c2c,
     fourier_r2c,
+    fourier_r2c_split,
 )
 from .field import Field2, average, average_axis, norm_l2  # noqa: F401
 from .models.lnse import Navier2DLnse, Navier2DNonLin  # noqa: F401

@@ -20,6 +20,7 @@ from __future__ import annotations
 import enum
 from functools import cached_property
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -36,6 +37,7 @@ class BaseKind(enum.Enum):
     CHEB_DIRICHLET_NEUMANN = "cheb_dirichlet_neumann"
     FOURIER_R2C = "fourier_r2c"
     FOURIER_C2C = "fourier_c2c"
+    FOURIER_R2C_SPLIT = "fourier_r2c_split"
 
     @property
     def is_chebyshev(self) -> bool:
@@ -48,7 +50,15 @@ class BaseKind(enum.Enum):
 
     @property
     def is_periodic(self) -> bool:
-        return self in (BaseKind.FOURIER_R2C, BaseKind.FOURIER_C2C)
+        return self in (
+            BaseKind.FOURIER_R2C,
+            BaseKind.FOURIER_C2C,
+            BaseKind.FOURIER_R2C_SPLIT,
+        )
+
+    @property
+    def is_split(self) -> bool:
+        return self == BaseKind.FOURIER_R2C_SPLIT
 
 
 def _dev(mat: np.ndarray):
@@ -270,6 +280,107 @@ class Base:
         return tr.apply_diag(g, vhat, axis)
 
 
+class SplitFourierBase(Base):
+    """Real r2c Fourier base in the split Re/Im representation: spectral
+    arrays are real with 2m rows, ``[Re(c_0..c_{m-1}); Im(c_0..c_{m-1})]``,
+    m = n//2+1.
+
+    This is the TPU form of ``fourier_r2c`` (the axon backend implements
+    neither complex dtypes nor FFT): transforms are single real MXU matmuls,
+    the (ik)^order spectral derivative becomes a block rotation of the Re/Im
+    halves, and diagonal solver ingredients carry each eigenvalue twice.
+    Numerically identical to the complex base — tested block-for-block
+    (tests/test_split.py)."""
+
+    def __init__(self, n: int):
+        super().__init__(BaseKind.FOURIER_R2C_SPLIT, n)
+        self.m_complex = n // 2 + 1
+        self.m = 2 * self.m_complex
+
+    @cached_property
+    def wavenumbers(self) -> np.ndarray:  # type: ignore[override]
+        """Each mode's k, duplicated across the Re and Im blocks — so the
+        diagonal operator algebra (-k^2 laplacians, modal solves) applies to
+        the split representation unchanged."""
+        k = fou.wavenumbers_r2c(self.n)
+        return np.concatenate([k, k])
+
+    @property
+    def spectral_is_complex(self) -> bool:  # type: ignore[override]
+        return False
+
+    # (operator matrices — mass/laplace/stencil/projection — inherit from
+    # Base: its non-Chebyshev branches already use the overridden duplicated
+    # wavenumbers and identity stencils)
+
+    # -- transforms ----------------------------------------------------------
+
+    @cached_property
+    def _fwd_dev(self):
+        return _dev(fou.split_forward_matrix(self.n))
+
+    @cached_property
+    def _bwd_dev(self):
+        return _dev(fou.split_backward_matrix(self.n))
+
+    def forward(self, v, axis: int, method: str = "matmul"):
+        del method  # matmul is the only (and native) path
+        return tr.apply_matrix(self._fwd_dev, v, axis)
+
+    def backward(self, vhat, axis: int, method: str = "matmul"):
+        del method
+        return tr.apply_matrix(self._bwd_dev, vhat, axis)
+
+    def backward_ortho(self, c, axis: int, method: str = "matmul"):
+        return self.backward(c, axis)
+
+    def to_ortho(self, vhat, axis: int):
+        return vhat
+
+    def from_ortho(self, c, axis: int):
+        return c
+
+    def gradient(self, vhat, order: int, axis: int):
+        """(ik)^order on the split blocks: i^order cycles through
+        (1, i, -1, -i), i.e. (re, im) -> (re, im), (-k im, k re),
+        -(re, im), (k im, -k re) times k^order."""
+        if order == 0:
+            return vhat
+        mc = self.m_complex
+        k = fou.wavenumbers_r2c(self.n) ** order
+        if order % 2 == 1 and self.n % 2 == 0:
+            k = k.copy()
+            k[-1] = 0.0  # Nyquist of odd derivatives (see fourier.diff_diag)
+        kd = jnp.asarray(k, dtype=vhat.dtype)
+        shape = [1] * vhat.ndim
+        shape[axis] = mc
+        kd = kd.reshape(shape)
+        re = jax.lax.slice_in_dim(vhat, 0, mc, axis=axis)
+        im = jax.lax.slice_in_dim(vhat, mc, 2 * mc, axis=axis)
+        quadrant = order % 4
+        if quadrant == 0:
+            re_n, im_n = kd * re, kd * im
+        elif quadrant == 1:
+            re_n, im_n = -kd * im, kd * re
+        elif quadrant == 2:
+            re_n, im_n = -kd * re, -kd * im
+        else:
+            re_n, im_n = kd * im, -kd * re
+        return jnp.concatenate([re_n, im_n], axis=axis)
+
+    # -- complex interop (checkpoint IO keeps the reference layout) ----------
+
+    def to_complex(self, vhat_split: np.ndarray, axis: int = 0) -> np.ndarray:
+        a = np.moveaxis(np.asarray(vhat_split), axis, 0)
+        out = a[: self.m_complex] + 1j * a[self.m_complex :]
+        return np.moveaxis(out, 0, axis)
+
+    def from_complex(self, vhat_c: np.ndarray, axis: int = 0) -> np.ndarray:
+        a = np.moveaxis(np.asarray(vhat_c), axis, 0)
+        out = np.concatenate([a.real, a.imag], axis=0)
+        return np.moveaxis(out, 0, axis)
+
+
 import weakref
 
 _BASE_CACHE: "weakref.WeakValueDictionary[tuple[BaseKind, int], Base]" = (
@@ -285,7 +396,9 @@ def _cached_base(kind: BaseKind, n: int) -> Base:
     key = (kind, n)
     base = _BASE_CACHE.get(key)
     if base is None:
-        base = Base(kind, n)
+        base = (
+            SplitFourierBase(n) if kind == BaseKind.FOURIER_R2C_SPLIT else Base(kind, n)
+        )
         _BASE_CACHE[key] = base
     return base
 
@@ -307,7 +420,17 @@ def cheb_dirichlet_neumann(n: int) -> Base:
 
 
 def fourier_r2c(n: int) -> Base:
+    """Real-to-complex Fourier base.  On backends without complex dtypes
+    (the TPU chip) this transparently returns the split Re/Im representation
+    (:class:`SplitFourierBase`), so periodic models run unchanged there."""
+    if not config.supports_complex():
+        return fourier_r2c_split(n)
     return _cached_base(BaseKind.FOURIER_R2C, n)
+
+
+def fourier_r2c_split(n: int) -> Base:
+    """Explicitly request the split Re/Im r2c base (any backend)."""
+    return _cached_base(BaseKind.FOURIER_R2C_SPLIT, n)
 
 
 def fourier_c2c(n: int) -> Base:
@@ -328,11 +451,18 @@ class Space2:
         if base_y.kind.is_periodic and not base_x.kind.is_periodic:
             raise ValueError("periodic y-axis under non-periodic x is unsupported")
         self.bases = (base_x, base_y)
-        if any(b.kind.is_periodic for b in self.bases) and not config.supports_complex():
+        if base_y.kind.is_split:
             raise NotImplementedError(
-                "Fourier axes need complex dtypes, which this TPU backend "
-                "lacks; use SplitSpace2 (split re/im representation) for "
-                "periodic configurations on TPU."
+                "the split Re/Im representation is implemented for the "
+                "x-axis only (the IO/pinning helpers assume a split axis 0); "
+                "doubly-periodic split spaces are unsupported"
+            )
+        if any(b.spectral_is_complex for b in self.bases) and not config.supports_complex():
+            raise NotImplementedError(
+                "complex Fourier bases are unsupported on this backend "
+                "(no complex dtypes); use fourier_r2c_split / the "
+                "fourier_r2c factory, which auto-selects the split "
+                "representation."
             )
         if method is None:
             # TPU (axon): no FFT and no complex dtypes -> dense MXU transforms.
@@ -437,3 +567,47 @@ class Space2:
             if factor != 1.0:
                 out = out / factor
         return out
+
+    # -- representation-aware helpers ---------------------------------------
+
+    def dealias_mask(self) -> np.ndarray:
+        """2/3-rule mask over this space's spectral shape
+        (/root/reference/src/navier_stokes/functions.rs:72-82); for a split
+        Fourier axis the cutoff applies per complex mode, i.e. to the Re and
+        Im blocks alike."""
+        mask = np.ones(self.shape_spectral)
+        cuts = []
+        for base in self.bases:
+            if base.kind.is_split:
+                mc = base.m_complex
+                cut1d = np.ones(base.m)
+                cut1d[mc * 2 // 3 : mc] = 0.0
+                cut1d[mc + mc * 2 // 3 :] = 0.0
+                cuts.append(cut1d)
+            else:
+                cut1d = np.ones(base.m)
+                cut1d[base.m * 2 // 3 :] = 0.0
+                cuts.append(cut1d)
+        return mask * cuts[0][:, None] * cuts[1][None, :]
+
+    def pin_zero_mode(self, vhat):
+        """Zero the constant mode (the pressure singularity pin,
+        /root/reference/src/navier_stokes/navier_eq.rs:158-162); a split
+        x-axis pins both the Re and the Im row of k=0."""
+        out = vhat.at[0, 0].set(0.0)
+        if self.bases[0].kind.is_split:
+            out = out.at[self.bases[0].m_complex, 0].set(0.0)
+        return out
+
+    def vhat_as_complex(self, vhat) -> np.ndarray:
+        """Host view of the coefficients in the complex convention (identity
+        for non-split spaces) — keeps checkpoint files layout-identical
+        across backends."""
+        if self.bases[0].kind.is_split:
+            return self.bases[0].to_complex(np.asarray(vhat), axis=0)
+        return np.asarray(vhat)
+
+    def vhat_from_complex(self, vhat_c: np.ndarray):
+        if self.bases[0].kind.is_split:
+            return self.bases[0].from_complex(vhat_c, axis=0)
+        return vhat_c

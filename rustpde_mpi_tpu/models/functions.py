@@ -23,18 +23,8 @@ def get_ka(ra: float, pr: float, height: float) -> float:
     return float(np.sqrt(1.0 / ((ra / height**3) * pr)))
 
 
-def dealias_mask(shape: tuple[int, int]) -> np.ndarray:
-    """2/3-rule dealiasing mask over the scratch field's spectral shape:
-    zero all modes with index >= 2/3 * m along either axis (matches the
-    reference's slice fills, /root/reference/src/navier_stokes/functions.rs:72-82,
-    including the slightly asymmetric cutoff for r2c axes whose mode count is
-    nx//2+1)."""
-    mask = np.ones(shape)
-    n_x = shape[0] * 2 // 3
-    n_y = shape[1] * 2 // 3
-    mask[n_x:, :] = 0.0
-    mask[:, n_y:] = 0.0
-    return mask
+# (the 2/3-rule dealias mask lives on Space2.dealias_mask — it needs the
+# per-axis representation, e.g. the split Re/Im blocks)
 
 
 def _normalized_coords(x: np.ndarray) -> np.ndarray:

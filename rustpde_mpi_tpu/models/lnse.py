@@ -220,7 +220,7 @@ class Navier2DLnse(Integrate):
                 vely_n, (0, 1), scale
             )
             pseu_n = sol_p.solve(div)
-            pseu_n = pseu_n.at[0, 0].set(0.0)
+            pseu_n = sp_q.pin_zero_mode(pseu_n)
             velx_n = velx_n - sp_u.from_ortho(sp_q.gradient(pseu_n, (1, 0), scale))
             vely_n = vely_n - sp_v.from_ortho(sp_q.gradient(pseu_n, (0, 1), scale))
             pres_n = pres - nu * div + sp_q.to_ortho(pseu_n) / dt
@@ -319,7 +319,7 @@ class Navier2DLnse(Integrate):
                 vely_n, (0, 1), scale
             )
             pseu_n = sol_p.solve(div)
-            pseu_n = pseu_n.at[0, 0].set(0.0)
+            pseu_n = sp_q.pin_zero_mode(pseu_n)
             velx_n = velx_n - sp_u.from_ortho(sp_q.gradient(pseu_n, (1, 0), scale))
             vely_n = vely_n - sp_v.from_ortho(sp_q.gradient(pseu_n, (0, 1), scale))
             pres_n = pres - nu * div + sp_q.to_ortho(pseu_n) / dt

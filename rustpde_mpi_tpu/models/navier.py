@@ -127,10 +127,8 @@ class Navier2D(Integrate):
         self.solver_temp = HholtzAdi(self.temp_space, (dt * ka / sx2, dt * ka / sy2))
         self.solver_pres = Poisson(self.pseu_space, (1.0 / sx2, 1.0 / sy2))
 
-        # dealiasing mask over the scratch spectral shape
-        self._dealias = jnp.asarray(
-            fns.dealias_mask(self.field_space.shape_spectral), dtype=rdt
-        )
+        # dealiasing mask over the scratch spectral shape (split-aware)
+        self._dealias = jnp.asarray(self.field_space.dealias_mask(), dtype=rdt)
 
         # boundary-condition lift fields as device constants
         with self._scope():
@@ -345,7 +343,7 @@ class Navier2D(Integrate):
                 vely_n, (0, 1), scale
             )
             pseu_n = sol_p.solve(div)
-            pseu_n = pseu_n.at[0, 0].set(0.0)  # remove singularity
+            pseu_n = sp_q.pin_zero_mode(pseu_n)  # remove singularity
             velx_n = velx_n - sp_u.from_ortho(sp_q.gradient(pseu_n, (1, 0), scale))
             vely_n = vely_n - sp_v.from_ortho(sp_q.gradient(pseu_n, (0, 1), scale))
             pres_n = pres - nu * div + sp_q.to_ortho(pseu_n) / dt

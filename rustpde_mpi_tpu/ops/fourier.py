@@ -27,6 +27,35 @@ def wavenumbers_c2c(n: int) -> np.ndarray:
     return np.fft.fftfreq(n, d=1.0 / n)
 
 
+def split_forward_matrix(n: int) -> np.ndarray:
+    """(2m x n) real matrix F with ``[Re(c); Im(c)] = F @ v`` equal to the
+    amplitude-normalized r2c transform (rfft/n), m = n//2+1.
+
+    The split representation is the TPU-native form of the r2c spectrum: the
+    axon backend has no complex dtypes and no FFT, so the transform runs as
+    one real MXU matmul over stacked Re/Im blocks."""
+    m = n // 2 + 1
+    j = np.arange(n)[None, :]
+    k = np.arange(m)[:, None]
+    ang = 2.0 * np.pi * k * j / n
+    return np.concatenate([np.cos(ang), -np.sin(ang)], axis=0) / n
+
+
+def split_backward_matrix(n: int) -> np.ndarray:
+    """(n x 2m) real synthesis matrix B with ``v = B @ [Re(c); Im(c)]``
+    (inverse of :func:`split_forward_matrix`; mode weights 1/2/1 for
+    k = 0 / interior / Nyquist-of-even-n)."""
+    m = n // 2 + 1
+    j = np.arange(n)[:, None]
+    k = np.arange(m)[None, :]
+    ang = 2.0 * np.pi * j * k / n
+    w = np.full(m, 2.0)
+    w[0] = 1.0
+    if n % 2 == 0:
+        w[-1] = 1.0
+    return np.concatenate([w * np.cos(ang), -w * np.sin(ang)], axis=1)
+
+
 def diff_diag(k: np.ndarray, order: int, n: int, r2c: bool) -> np.ndarray:
     """Diagonal of (d/dx)^order in spectral space: (i k)^order.
 

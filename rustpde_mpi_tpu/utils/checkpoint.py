@@ -95,38 +95,51 @@ def interpolate_2d(
 
 
 def write_field(h5, varname: str, space: Space2, vhat, x, dx) -> None:
-    """Write one field group in the reference layout."""
+    """Write one field group in the reference layout.  Split-Fourier spaces
+    store their coefficients in the complex convention (vhat_re/vhat_im), so
+    files are layout-identical across backends."""
     grp = h5.require_group(varname)
     _write_array(grp, "x", x[0])
     _write_array(grp, "dx", dx[0])
     _write_array(grp, "y", x[1])
     _write_array(grp, "dy", dx[1])
     _write_array(grp, "v", np.asarray(space.backward(vhat)))
-    _write_array(grp, "vhat", np.asarray(vhat))
+    _write_array(grp, "vhat", space.vhat_as_complex(vhat))
 
 
 def read_field_vhat(h5, varname: str, space: Space2) -> np.ndarray:
-    """Read one field's spectral coefficients, interpolating on mismatch."""
+    """Read one field's spectral coefficients, interpolating on mismatch.
+
+    Files always carry the complex convention for periodic axes; a split
+    target space converts after the (complex-domain) interpolation."""
     grp = h5[varname]
-    data = _read_array(grp, "vhat", space.spectral_is_complex)
+    split = space.bases[0].kind.is_split
+    is_complex = space.spectral_is_complex or split
+    data = _read_array(grp, "vhat", is_complex)
     old_nx = grp["x"].shape[0] if "x" in grp else None
+    if split:
+        target_shape = (space.bases[0].m_complex, space.bases[1].m)
+        kind_x = BaseKind.FOURIER_R2C
+    else:
+        target_shape = space.shape_spectral
+        kind_x = space.base_kind(0)
     # interpolate on shape mismatch, and also when the shapes agree but the
     # r2c grid parity changed (nx 16 -> 17 keeps m = 9 yet re-types the
     # Nyquist row)
     parity_flip = (
-        space.base_kind(0) == BaseKind.FOURIER_R2C
+        kind_x == BaseKind.FOURIER_R2C
         and old_nx is not None
         and old_nx % 2 != space.shape_physical[0] % 2
     )
-    if data.shape != space.shape_spectral or parity_flip:
+    if data.shape != target_shape or parity_flip:
         data = interpolate_2d(
             data,
-            space.shape_spectral,
-            space.base_kind(0),
+            target_shape,
+            kind_x,
             old_nx=old_nx,
             new_nx=space.shape_physical[0],
         )
-    return data
+    return space.vhat_from_complex(data) if split else data
 
 
 def _model_coords(model):

@@ -13,7 +13,6 @@ from __future__ import annotations
 import numpy as np
 
 from ..bases import Space2, cheb_dirichlet, chebyshev, fourier_r2c
-from ..models import functions as fns
 from .checkpoint import _write_array, read_field_vhat
 
 
@@ -55,13 +54,11 @@ def _vorticity(fname: str, periodic: bool) -> None:
     dudz = vel_space.gradient(uxhat, (0, 1), (1.0, 1.0))
     dvdx = vel_space.gradient(uyhat, (1, 0), (1.0, 1.0))
     vort = dvdx - dudz
-    mask = jnp.asarray(
-        fns.dealias_mask(vort_space.shape_spectral), dtype=vort.real.dtype
-    )
+    mask = jnp.asarray(vort_space.dealias_mask(), dtype=vort.real.dtype)
     vort = vort * mask
     v = np.asarray(vort_space.backward_ortho(vort))
 
     with h5py.File(fname, "a") as h5:
         grp = h5.require_group("vorticity")
         _write_array(grp, "v", v)
-        _write_array(grp, "vhat", np.asarray(vort))
+        _write_array(grp, "vhat", vort_space.vhat_as_complex(vort))
