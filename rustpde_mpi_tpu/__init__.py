@@ -24,13 +24,15 @@ from .bases import (  # noqa: F401
     fourier_r2c,
     fourier_r2c_split,
 )
-from .field import Field2, average, average_axis, norm_l2  # noqa: F401
+from .bases import BiPeriodicSpace2, Space1  # noqa: F401
+from .field import Field1, Field2, average, average_axis, norm_l2  # noqa: F401
 from .models.lnse import Navier2DLnse, Navier2DNonLin  # noqa: F401
 from .models.meanfield import MeanFields  # noqa: F401
 from .models.navier import Navier2D, NavierState  # noqa: F401
 from .models.opt_routines import steepest_descent_energy_constrained  # noqa: F401
 from .models.statistics import Statistics  # noqa: F401
 from .models.steady_adjoint import Navier2DAdjoint  # noqa: F401
+from .models.swift_hohenberg import SwiftHohenberg1D, SwiftHohenberg2D  # noqa: F401
 from .utils.integrate import Integrate, integrate  # noqa: F401
 from .utils.vorticity import (  # noqa: F401
     vorticity_auto,

@@ -279,6 +279,14 @@ class Base:
             return tr.apply_matrix(g, vhat, axis)
         return tr.apply_diag(g, vhat, axis)
 
+    def dealias_cut(self) -> np.ndarray:
+        """1-D 2/3-rule mask over this base's spectral rows
+        (/root/reference/src/navier_stokes/functions.rs:72-82); the single
+        home of the cutoff convention for every space class."""
+        cut = np.ones(self.m)
+        cut[self.m * 2 // 3 :] = 0.0
+        return cut
+
 
 class SplitFourierBase(Base):
     """Real r2c Fourier base in the split Re/Im representation: spectral
@@ -367,6 +375,15 @@ class SplitFourierBase(Base):
         else:
             re_n, im_n = kd * im, -kd * re
         return jnp.concatenate([re_n, im_n], axis=axis)
+
+    def dealias_cut(self) -> np.ndarray:
+        """2/3-rule applied per complex mode — the Re and Im blocks get the
+        same cutoff."""
+        mc = self.m_complex
+        cut = np.ones(self.m)
+        cut[mc * 2 // 3 : mc] = 0.0
+        cut[mc + mc * 2 // 3 :] = 0.0
+        return cut
 
     # -- complex interop (checkpoint IO keeps the reference layout) ----------
 
@@ -574,21 +591,9 @@ class Space2:
         """2/3-rule mask over this space's spectral shape
         (/root/reference/src/navier_stokes/functions.rs:72-82); for a split
         Fourier axis the cutoff applies per complex mode, i.e. to the Re and
-        Im blocks alike."""
-        mask = np.ones(self.shape_spectral)
-        cuts = []
-        for base in self.bases:
-            if base.kind.is_split:
-                mc = base.m_complex
-                cut1d = np.ones(base.m)
-                cut1d[mc * 2 // 3 : mc] = 0.0
-                cut1d[mc + mc * 2 // 3 :] = 0.0
-                cuts.append(cut1d)
-            else:
-                cut1d = np.ones(base.m)
-                cut1d[base.m * 2 // 3 :] = 0.0
-                cuts.append(cut1d)
-        return mask * cuts[0][:, None] * cuts[1][None, :]
+        Im blocks alike (Base.dealias_cut)."""
+        cuts = [base.dealias_cut() for base in self.bases]
+        return cuts[0][:, None] * cuts[1][None, :]
 
     def pin_zero_mode(self, vhat):
         """Zero the constant mode (the pressure singularity pin,
@@ -611,3 +616,261 @@ class Space2:
         if self.bases[0].kind.is_split:
             return self.bases[0].from_complex(vhat_c, axis=0)
         return vhat_c
+
+
+class Space1:
+    """One-dimensional spectral space — the funspace ``Space1`` analog the
+    reference's 1-D fields are built on (/root/reference/src/field.rs:59-72;
+    consumed by examples/swift_hohenberg_1d.rs and the 1-D demos).
+
+    Same execution-path selection as :class:`Space2`: FFT transforms except
+    on the TPU backend, where dense MXU matmuls are used.  ``fourier_r2c``
+    transparently becomes the split Re/Im representation there, so 1-D
+    periodic models run on-chip unchanged.
+    """
+
+    def __init__(self, base: Base, method: str | None = None):
+        if base.spectral_is_complex and not config.supports_complex():
+            raise NotImplementedError(
+                "complex Fourier bases are unsupported on this backend; "
+                "use the fourier_r2c factory (auto-selects the split "
+                "representation)"
+            )
+        self.base = base
+        self.bases = (base,)
+        if method is None:
+            method = "matmul" if config.is_tpu_like() else "fft"
+        self.method = method
+
+    @property
+    def shape_physical(self) -> tuple[int]:
+        return (self.base.n,)
+
+    @property
+    def shape_spectral(self) -> tuple[int]:
+        return (self.base.m,)
+
+    @property
+    def spectral_is_complex(self) -> bool:
+        return self.base.spectral_is_complex
+
+    def spectral_dtype(self):
+        return config.complex_dtype() if self.spectral_is_complex else config.real_dtype()
+
+    def base_kind(self, axis: int = 0) -> BaseKind:
+        return self.base.kind
+
+    def coords(self) -> list[np.ndarray]:
+        return [self.base.points]
+
+    def ndarray_physical(self):
+        return jnp.zeros(self.shape_physical, dtype=config.real_dtype())
+
+    def ndarray_spectral(self):
+        return jnp.zeros(self.shape_spectral, dtype=self.spectral_dtype())
+
+    def forward(self, v):
+        return self.base.forward(v, 0, self.method)
+
+    def backward(self, vhat):
+        return self.base.backward(vhat, 0, self.method)
+
+    def backward_ortho(self, c):
+        return self.base.backward_ortho(c, 0, self.method)
+
+    def to_ortho(self, vhat):
+        return self.base.to_ortho(vhat, 0)
+
+    def from_ortho(self, c):
+        return self.base.from_ortho(c, 0)
+
+    def gradient(self, vhat, deriv, scale=None):
+        """d^deriv/dx in ortho space, divided by scale^deriv like the
+        reference (/root/reference/src/field.rs:127).  ``deriv`` may be an
+        int or a 1-element sequence."""
+        order = deriv if isinstance(deriv, int) else deriv[0]
+        out = self.base.gradient(vhat, order, 0)
+        if scale is not None:
+            s = scale if isinstance(scale, (int, float)) else scale[0]
+            factor = float(s) ** order
+            if factor != 1.0:
+                out = out / factor
+        return out
+
+    def dealias_mask(self) -> np.ndarray:
+        """2/3-rule mask (the 1-D form of Space2.dealias_mask; matches the
+        reference's 1-D cutoff, examples/swift_hohenberg_1d.rs dealias)."""
+        return self.base.dealias_cut()
+
+    def pin_zero_mode(self, vhat):
+        out = vhat.at[0].set(0.0)
+        if self.base.kind.is_split:
+            out = out.at[self.base.m_complex].set(0.0)
+        return out
+
+    def vhat_as_complex(self, vhat) -> np.ndarray:
+        if self.base.kind.is_split:
+            return self.base.to_complex(np.asarray(vhat), axis=0)
+        return np.asarray(vhat)
+
+    def vhat_from_complex(self, vhat_c: np.ndarray):
+        if self.base.kind.is_split:
+            return self.base.from_complex(vhat_c, axis=0)
+        return vhat_c
+
+
+class BiPeriodicSpace2:
+    """Doubly-periodic real 2-D space (Fourier x Fourier), split Re/Im layout.
+
+    The reference's Swift–Hohenberg demo runs on ``fourier_c2c x fourier_r2c``
+    with complex coefficients (/root/reference/examples/swift_hohenberg_2d.rs).
+    A complex c2c axis cannot ride the per-axis split trick of
+    :class:`SplitFourierBase` (a c2c transform mixes Re and Im across the
+    *other* axis's blocks), so the doubly-periodic case gets its own space:
+    spectral data is a real ``(2, nx, my)`` array — plane 0 = Re, plane 1 =
+    Im of the c2c x r2c coefficients, ``my = ny//2+1`` — and the transforms
+    run either as XLA FFTs (CPU) or as real MXU matmuls handling the Re/Im
+    mixing explicitly (TPU: no FFT, no complex dtypes).  Normalization is
+    amplitude (fft/n per axis), matching ops/fourier.
+    """
+
+    def __init__(self, nx: int, ny: int, method: str | None = None):
+        self.nx, self.ny = nx, ny
+        self.my = ny // 2 + 1
+        if method is None:
+            method = "matmul" if config.is_tpu_like() else "fft"
+        self.method = method
+        self.kx = fou.wavenumbers_c2c(nx)
+        self.ky = fou.wavenumbers_r2c(ny)
+
+    # -- geometry -----------------------------------------------------------
+
+    @property
+    def shape_physical(self) -> tuple[int, int]:
+        return (self.nx, self.ny)
+
+    @property
+    def shape_spectral(self) -> tuple[int, int, int]:
+        return (2, self.nx, self.my)
+
+    def coords(self) -> list[np.ndarray]:
+        return [fou.fourier_points(self.nx), fou.fourier_points(self.ny)]
+
+    def ndarray_physical(self):
+        return jnp.zeros(self.shape_physical, dtype=config.real_dtype())
+
+    def ndarray_spectral(self):
+        return jnp.zeros(self.shape_spectral, dtype=config.real_dtype())
+
+    # -- transform matrices (host, lazily built) ----------------------------
+
+    @cached_property
+    def _y_fwd(self):
+        return _dev(fou.split_forward_matrix(self.ny))  # (2my, ny)
+
+    @cached_property
+    def _y_bwd(self):
+        return _dev(fou.split_backward_matrix(self.ny))  # (ny, 2my)
+
+    @cached_property
+    def _x_cos_fwd(self):
+        k = np.arange(self.nx)[:, None] * np.arange(self.nx)[None, :]
+        return _dev(np.cos(2.0 * np.pi * k / self.nx) / self.nx)
+
+    @cached_property
+    def _x_sin_fwd(self):
+        k = np.arange(self.nx)[:, None] * np.arange(self.nx)[None, :]
+        return _dev(np.sin(2.0 * np.pi * k / self.nx) / self.nx)
+
+    @cached_property
+    def _x_cos_bwd(self):
+        k = np.arange(self.nx)[:, None] * np.arange(self.nx)[None, :]
+        return _dev(np.cos(2.0 * np.pi * k / self.nx))
+
+    @cached_property
+    def _x_sin_bwd(self):
+        k = np.arange(self.nx)[:, None] * np.arange(self.nx)[None, :]
+        return _dev(np.sin(2.0 * np.pi * k / self.nx))
+
+    # -- transforms ----------------------------------------------------------
+
+    def forward(self, v):
+        """Real physical (nx, ny) -> split spectral (2, nx, my)."""
+        if self.method == "fft":
+            c = jnp.fft.fft(jnp.fft.rfft(v, axis=1) / self.ny, axis=0) / self.nx
+            return jnp.stack([c.real, c.imag]).astype(v.dtype)
+        w = v @ self._y_fwd.T  # (nx, 2my): [Re | Im] blocks of the y-r2c
+        re1, im1 = w[:, : self.my], w[:, self.my :]
+        # x-axis c2c forward F = C - iS applied to re1 + i*im1
+        re = self._x_cos_fwd @ re1 + self._x_sin_fwd @ im1
+        im = self._x_cos_fwd @ im1 - self._x_sin_fwd @ re1
+        return jnp.stack([re, im])
+
+    def backward(self, s):
+        """Split spectral (2, nx, my) -> real physical (nx, ny)."""
+        if self.method == "fft":
+            c = (s[0] + 1j * s[1]).astype(config.complex_dtype())
+            mid = jnp.fft.ifft(c * self.nx, axis=0)
+            return jnp.fft.irfft(mid * self.ny, n=self.ny, axis=1).astype(s.dtype)
+        # x-axis inverse c2c B = C + iS
+        mid_re = self._x_cos_bwd @ s[0] - self._x_sin_bwd @ s[1]
+        mid_im = self._x_cos_bwd @ s[1] + self._x_sin_bwd @ s[0]
+        # y-axis r2c synthesis on the [Re | Im] blocks (imag part of the
+        # physical signal is structurally zero and never materialized)
+        return jnp.concatenate([mid_re, mid_im], axis=1) @ self._y_bwd.T
+
+    # -- spectral operators --------------------------------------------------
+
+    def _grad_factor(self, deriv) -> np.ndarray:
+        """(i kx)^dx (i ky)^dy over the (nx, my) mode grid (complex host
+        array), odd-order Nyquist modes zeroed (see ops/fourier.diff_diag)."""
+        fx = fou.diff_diag(self.kx, deriv[0], self.nx, r2c=False)
+        fy = fou.diff_diag(self.ky, deriv[1], self.ny, r2c=True)
+        return fx[:, None] * fy[None, :]
+
+    def gradient(self, s, deriv, scale=None):
+        """Mixed derivative in spectral space on the split layout."""
+        f = self._grad_factor(deriv)
+        if scale is not None:
+            f = f / ((scale[0] ** deriv[0]) * (scale[1] ** deriv[1]))
+        fre = jnp.asarray(f.real, dtype=s.dtype)
+        fim = jnp.asarray(f.imag, dtype=s.dtype)
+        return jnp.stack(
+            [fre * s[0] - fim * s[1], fre * s[1] + fim * s[0]]
+        )
+
+    def dealias_mask(self) -> np.ndarray:
+        """2/3-rule over both axes, shape (nx, my).  Same integer-floor
+        cutoff convention as Base.dealias_cut (keep |k| < floor(2m/3)); the
+        c2c x-axis is cut by wavenumber magnitude."""
+        mx = self.nx // 2 + 1
+        cx = (np.abs(self.kx) < (mx * 2) // 3).astype(np.float64)
+        cy = np.ones(self.my)
+        cy[(self.my * 2) // 3 :] = 0.0
+        return cx[:, None] * cy[None, :]
+
+    def pin_zero_mode(self, s):
+        return s.at[:, 0, 0].set(0.0)
+
+    def enforce_hermitian_x(self, s):
+        """Make the ky=0 column conjugate-symmetric in kx — a real physical
+        field demands c(-kx, 0) = conj(c(kx, 0)); drift breaks the implicit
+        update's stability (/root/reference/examples/swift_hohenberg_2d.rs
+        enforce_hermitian_symmetry)."""
+        col_re, col_im = s[0, :, 0], s[1, :, 0]
+        # conjugate pairing index: k -> (nx - k) % nx
+        idx = (-jnp.arange(self.nx)) % self.nx
+        sym_re = 0.5 * (col_re + col_re[idx])
+        sym_im = 0.5 * (col_im - col_im[idx])
+        out = s.at[0, :, 0].set(sym_re)
+        return out.at[1, :, 0].set(sym_im)
+
+    # -- complex interop (checkpoint IO keeps the reference layout) ----------
+
+    def vhat_as_complex(self, s) -> np.ndarray:
+        a = np.asarray(s)
+        return a[0] + 1j * a[1]
+
+    def vhat_from_complex(self, c: np.ndarray) -> np.ndarray:
+        c = np.asarray(c)
+        return np.stack([c.real, c.imag])

@@ -131,3 +131,55 @@ def norm_l2(a) -> jnp.ndarray:
     if jnp.iscomplexobj(a):
         return jnp.sqrt(jnp.sum(a.real**2 + a.imag**2))
     return jnp.sqrt(jnp.sum(a**2))
+
+
+class Field1:
+    """One-dimensional field on a :class:`~rustpde_mpi_tpu.bases.Space1`
+    (reference ``Field1``, /root/reference/src/field.rs:59-72; used by the
+    1-D Swift–Hohenberg example)."""
+
+    def __init__(self, space):
+        self.space = space
+        self.vhat = space.ndarray_spectral()
+        self.x = [space.base.points.copy()]
+        self.dx = [grid_deltas(space.base.points, space.base.is_periodic)]
+
+    def scale(self, scale):
+        s = scale if isinstance(scale, (int, float)) else scale[0]
+        self.x[0] = self.x[0] * s
+        self.dx[0] = self.dx[0] * s
+
+    @property
+    def v(self):
+        return self.space.backward(self.vhat)
+
+    @v.setter
+    def v(self, values):
+        dtype = (
+            config.complex_dtype()
+            if self.space.base.kind == BaseKind.FOURIER_C2C
+            else config.real_dtype()
+        )
+        self.vhat = self.space.forward(jnp.asarray(values, dtype=dtype))
+
+    def forward(self, v):
+        self.vhat = self.space.forward(v)
+
+    def backward(self):
+        return self.space.backward(self.vhat)
+
+    def to_ortho(self):
+        return self.space.to_ortho(self.vhat)
+
+    def from_ortho(self, c):
+        self.vhat = self.space.from_ortho(c)
+
+    def gradient(self, deriv, scale=None):
+        return self.space.gradient(self.vhat, deriv, scale)
+
+    def average(self):
+        periodic = self.space.base.is_periodic
+        length = _axis_length(self.x, self.dx, 0, periodic)
+        v = self.v
+        w = jnp.asarray(self.dx[0] / length, dtype=v.dtype)
+        return jnp.sum(v * w)

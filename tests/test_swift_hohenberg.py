@@ -1,0 +1,245 @@
+"""Space1/Field1 + Swift–Hohenberg tests.
+
+Test model follows SURVEY.md S4: transform round-trips and derivative checks
+for the 1-D spaces, linear-growth-rate validation of the SH IMEX scheme
+against the exact modal update factor, and split-vs-complex equality of the
+doubly-periodic space (the TPU representation checked against the CPU FFT
+path on identical data)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from rustpde_mpi_tpu import config
+from rustpde_mpi_tpu.bases import (
+    BiPeriodicSpace2,
+    Space1,
+    cheb_dirichlet,
+    chebyshev,
+    fourier_r2c,
+    fourier_r2c_split,
+)
+from rustpde_mpi_tpu.field import Field1
+from rustpde_mpi_tpu.models.swift_hohenberg import (
+    SwiftHohenberg1D,
+    SwiftHohenberg2D,
+)
+
+
+# ---------------------------------------------------------------------------
+# Space1 / Field1
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize(
+    "base_fn", [chebyshev, cheb_dirichlet, fourier_r2c, fourier_r2c_split]
+)
+def test_space1_roundtrip(base_fn):
+    n = 24
+    space = Space1(base_fn(n))
+    rng = np.random.default_rng(3)
+    if space.base.kind.is_chebyshev and space.base.m < n:
+        # composite base: start from spectral coefficients (not every physical
+        # field satisfies the BCs)
+        vhat = jnp.asarray(rng.standard_normal(space.base.m))
+        v = space.backward(vhat)
+        vhat2 = space.forward(v)
+        np.testing.assert_allclose(np.asarray(vhat2), np.asarray(vhat), atol=1e-10)
+    else:
+        v = jnp.asarray(rng.standard_normal(n))
+        v2 = space.backward(space.forward(v))
+        np.testing.assert_allclose(np.asarray(v2), np.asarray(v), atol=1e-10)
+
+
+def test_space1_gradient_fourier():
+    n = 32
+    space = Space1(fourier_r2c(n))
+    x = space.base.points
+    v = jnp.asarray(np.sin(3 * x))
+    dv = space.backward_ortho(space.gradient(space.forward(v), 1))
+    np.testing.assert_allclose(np.asarray(dv), 3 * np.cos(3 * x), atol=1e-10)
+    # with a length scale: d/dx sin(3 x/L) = (3/L) cos(3 x/L)
+    dv_s = space.backward_ortho(space.gradient(space.forward(v), 1, scale=[2.0]))
+    np.testing.assert_allclose(np.asarray(dv_s), 1.5 * np.cos(3 * x), atol=1e-10)
+
+
+def test_space1_gradient_chebyshev():
+    n = 24
+    space = Space1(chebyshev(n))
+    x = space.base.points
+    v = jnp.asarray(x**3)
+    dv = space.backward_ortho(space.gradient(space.forward(v), 1))
+    np.testing.assert_allclose(np.asarray(dv), 3 * x**2, atol=1e-8)
+
+
+def test_space1_split_matches_complex():
+    n = 20
+    rng = np.random.default_rng(7)
+    v = rng.standard_normal(n)
+    sc = Space1(fourier_r2c(n), method="fft")
+    ss = Space1(fourier_r2c_split(n))
+    c = np.asarray(sc.forward(jnp.asarray(v)))
+    s = np.asarray(ss.forward(jnp.asarray(v)))
+    m = n // 2 + 1
+    np.testing.assert_allclose(s[:m], c.real, atol=1e-12)
+    np.testing.assert_allclose(s[m:], c.imag, atol=1e-12)
+    # gradient equivalence through the physical representation
+    g_c = np.asarray(sc.backward_ortho(sc.gradient(sc.forward(jnp.asarray(v)), 2)))
+    g_s = np.asarray(ss.backward_ortho(ss.gradient(ss.forward(jnp.asarray(v)), 2)))
+    np.testing.assert_allclose(g_s, g_c, atol=1e-10)
+
+
+def test_field1_api():
+    space = Space1(fourier_r2c(16))
+    f = Field1(space)
+    f.v = np.cos(space.base.points)
+    f.scale([2.0])
+    assert f.x[0][-1] > 6.0  # stretched
+    np.testing.assert_allclose(float(f.average()), 0.0, atol=1e-12)
+
+
+# ---------------------------------------------------------------------------
+# BiPeriodicSpace2: split matmul path vs complex FFT path
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("shape", [(16, 16), (12, 17), (15, 14)])
+def test_biperiodic_roundtrip(shape):
+    nx, ny = shape
+    rng = np.random.default_rng(5)
+    v = rng.standard_normal((nx, ny))
+    for method in ("fft", "matmul"):
+        space = BiPeriodicSpace2(nx, ny, method=method)
+        v2 = np.asarray(space.backward(space.forward(jnp.asarray(v))))
+        np.testing.assert_allclose(v2, v, atol=1e-10, err_msg=method)
+
+
+def test_biperiodic_split_matches_complex_fft():
+    nx, ny = 16, 18
+    rng = np.random.default_rng(11)
+    v = jnp.asarray(rng.standard_normal((nx, ny)))
+    s_fft = np.asarray(BiPeriodicSpace2(nx, ny, method="fft").forward(v))
+    s_mm = np.asarray(BiPeriodicSpace2(nx, ny, method="matmul").forward(v))
+    np.testing.assert_allclose(s_mm, s_fft, atol=1e-12)
+    # against direct numpy reference
+    c = np.fft.fft(np.fft.rfft(np.asarray(v), axis=1) / ny, axis=0) / nx
+    np.testing.assert_allclose(s_fft[0], c.real, atol=1e-12)
+    np.testing.assert_allclose(s_fft[1], c.imag, atol=1e-12)
+
+
+def test_biperiodic_gradient():
+    nx, ny = 24, 24
+    space = BiPeriodicSpace2(nx, ny)
+    x, y = space.coords()
+    v = jnp.asarray(np.sin(2 * x)[:, None] * np.cos(3 * y)[None, :])
+    # d2/dx2: -4 * v
+    lap = space.backward(space.gradient(space.forward(v), (2, 0)))
+    np.testing.assert_allclose(np.asarray(lap), -4 * np.asarray(v), atol=1e-9)
+    # mixed: d/dx d/dy
+    g = space.backward(space.gradient(space.forward(v), (1, 1)))
+    expect = 2 * np.cos(2 * x)[:, None] * (-3 * np.sin(3 * y)[None, :])
+    np.testing.assert_allclose(np.asarray(g), expect, atol=1e-9)
+
+
+def test_biperiodic_hermitian_projection():
+    nx, ny = 12, 12
+    space = BiPeriodicSpace2(nx, ny)
+    rng = np.random.default_rng(2)
+    v = jnp.asarray(rng.standard_normal((nx, ny)))
+    s = space.forward(v)
+    # coefficients of a real field are already Hermitian -> projection is id
+    s2 = space.enforce_hermitian_x(s)
+    np.testing.assert_allclose(np.asarray(s2), np.asarray(s), atol=1e-12)
+    # a perturbed column is symmetrized: c(-k,0) == conj(c(k,0))
+    bad = s.at[0, 3, 0].add(0.5)
+    fixed = np.asarray(space.enforce_hermitian_x(bad))
+    np.testing.assert_allclose(fixed[0, 3, 0], fixed[0, nx - 3, 0], atol=1e-12)
+    np.testing.assert_allclose(fixed[1, 3, 0], -fixed[1, nx - 3, 0], atol=1e-12)
+
+
+# ---------------------------------------------------------------------------
+# Swift–Hohenberg physics
+# ---------------------------------------------------------------------------
+
+
+def test_sh1d_linear_growth_rate():
+    """Tiny-amplitude single mode evolves by the exact IMEX modal factor
+    1/(1 + dt*((1-k^2)^2 - r)) per step (cubic negligible at 1e-8)."""
+    nx, length, r, dt = 64, 2.0, 0.3, 0.05
+    model = SwiftHohenberg1D(nx, r, dt, length)
+    x = model.x[0]
+    mode = 2  # k = mode / length
+    eps = 1e-8
+    model.set_theta(eps * np.cos(mode * x / length))
+    a0 = np.max(np.abs(model.theta_physical()))
+    nsteps = 20
+    model.update_n(nsteps)
+    a1 = np.max(np.abs(model.theta_physical()))
+    k = mode / length
+    factor = (1.0 / (1.0 + dt * ((1.0 - k**2) ** 2 - r))) ** nsteps
+    np.testing.assert_allclose(a1 / a0, factor, rtol=1e-6)
+
+
+def test_sh1d_supercritical_saturates():
+    """r > 0: the near-critical mode grows, then the cubic saturates it near
+    amplitude ~ 2*sqrt(r/3) (the classic SH roll amplitude)."""
+    nx, length, r, dt = 128, 10.0, 0.2, 0.05
+    model = SwiftHohenberg1D(nx, r, dt, length)
+    model.update_n(4000)
+    amp = np.max(np.abs(model.theta_physical()))
+    assert not model.exit()
+    assert 0.1 < amp < 1.0  # grown from 1e-5, bounded by the cubic
+
+
+def test_sh2d_linear_growth_rate():
+    nx = ny = 32
+    length, r, dt = 2.0, 0.25, 0.02
+    model = SwiftHohenberg2D(nx, ny, r, dt, length)
+    x, y = model.x
+    eps = 1e-8
+    mx, my_ = 2, 1
+    v = eps * np.cos(mx * x[:, None] / length) * np.cos(my_ * y[None, :] / length)
+    model.set_theta(v)
+    a0 = np.max(np.abs(model.theta_physical()))
+    nsteps = 10
+    model.update_n(nsteps)
+    a1 = np.max(np.abs(model.theta_physical()))
+    k2 = (mx / length) ** 2 + (my_ / length) ** 2
+    factor = (1.0 / (1.0 + dt * ((1.0 - k2) ** 2 - r))) ** nsteps
+    np.testing.assert_allclose(a1 / a0, factor, rtol=1e-6)
+
+
+def test_sh2d_pattern_forms_and_is_bounded():
+    nx = ny = 48
+    model = SwiftHohenberg2D(nx, ny, r=0.35, dt=0.02, length=8.0)
+    e0 = model.pattern_energy()
+    model.update_n(2500)
+    e1 = model.pattern_energy()
+    assert not model.exit()
+    assert e1 > 50 * e0  # pattern grew out of the random IC
+    assert np.max(np.abs(model.theta_physical())) < 2.0  # cubic bounded
+
+
+def test_sh2d_write_read_roundtrip(tmp_path):
+    model = SwiftHohenberg2D(16, 16, r=0.3, dt=0.02, length=5.0)
+    model.update_n(5)
+    fname = str(tmp_path / "sh.h5")
+    model._write(fname)
+    model2 = SwiftHohenberg2D(16, 16, r=0.3, dt=0.02, length=5.0)
+    model2.read(fname)
+    assert model2.time == pytest.approx(model.time)
+    np.testing.assert_allclose(
+        model2.theta_physical(), model.theta_physical(), atol=1e-12
+    )
+
+
+def test_sh1d_write_read_roundtrip(tmp_path):
+    model = SwiftHohenberg1D(32, r=0.2, dt=0.01, length=10.0)
+    model.update_n(3)
+    fname = str(tmp_path / "sh1.h5")
+    model._write(fname)
+    model2 = SwiftHohenberg1D(32, r=0.2, dt=0.01, length=10.0)
+    model2.read(fname)
+    np.testing.assert_allclose(
+        model2.theta_physical(), model.theta_physical(), atol=1e-12
+    )
