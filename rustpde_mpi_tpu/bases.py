@@ -773,22 +773,12 @@ class BiPeriodicSpace2:
         return _dev(fou.split_backward_matrix(self.ny))  # (ny, 2my)
 
     @cached_property
-    def _x_cos_fwd(self):
-        k = np.arange(self.nx)[:, None] * np.arange(self.nx)[None, :]
-        return _dev(np.cos(2.0 * np.pi * k / self.nx) / self.nx)
-
-    @cached_property
-    def _x_sin_fwd(self):
-        k = np.arange(self.nx)[:, None] * np.arange(self.nx)[None, :]
-        return _dev(np.sin(2.0 * np.pi * k / self.nx) / self.nx)
-
-    @cached_property
-    def _x_cos_bwd(self):
+    def _x_cos(self):
         k = np.arange(self.nx)[:, None] * np.arange(self.nx)[None, :]
         return _dev(np.cos(2.0 * np.pi * k / self.nx))
 
     @cached_property
-    def _x_sin_bwd(self):
+    def _x_sin(self):
         k = np.arange(self.nx)[:, None] * np.arange(self.nx)[None, :]
         return _dev(np.sin(2.0 * np.pi * k / self.nx))
 
@@ -802,8 +792,10 @@ class BiPeriodicSpace2:
         w = v @ self._y_fwd.T  # (nx, 2my): [Re | Im] blocks of the y-r2c
         re1, im1 = w[:, : self.my], w[:, self.my :]
         # x-axis c2c forward F = C - iS applied to re1 + i*im1
-        re = self._x_cos_fwd @ re1 + self._x_sin_fwd @ im1
-        im = self._x_cos_fwd @ im1 - self._x_sin_fwd @ re1
+        # forward c2c matrices are the backward pair scaled by 1/nx — share
+        # the device constants and fold the scalar in here
+        re = (self._x_cos @ re1 + self._x_sin @ im1) / self.nx
+        im = (self._x_cos @ im1 - self._x_sin @ re1) / self.nx
         return jnp.stack([re, im])
 
     def backward(self, s):
@@ -813,8 +805,8 @@ class BiPeriodicSpace2:
             mid = jnp.fft.ifft(c * self.nx, axis=0)
             return jnp.fft.irfft(mid * self.ny, n=self.ny, axis=1).astype(s.dtype)
         # x-axis inverse c2c B = C + iS
-        mid_re = self._x_cos_bwd @ s[0] - self._x_sin_bwd @ s[1]
-        mid_im = self._x_cos_bwd @ s[1] + self._x_sin_bwd @ s[0]
+        mid_re = self._x_cos @ s[0] - self._x_sin @ s[1]
+        mid_im = self._x_cos @ s[1] + self._x_sin @ s[0]
         # y-axis r2c synthesis on the [Re | Im] blocks (imag part of the
         # physical signal is structurally zero and never materialized)
         return jnp.concatenate([mid_re, mid_im], axis=1) @ self._y_bwd.T

@@ -91,6 +91,7 @@ class Navier2D(Integrate):
         self.write_intervall: float | None = None
         self.statistics = None
         self._obs_cache: tuple | None = None
+        self._solid = None  # (penalization factors) set via set_solid()
 
         x_base = fourier_r2c if periodic else cheb_dirichlet
         x_full = fourier_r2c if periodic else chebyshev
@@ -252,6 +253,63 @@ class Navier2D(Integrate):
         # (/root/reference/src/navier_stokes/navier_io.rs:44-62); the profile
         # itself remains available as bcs.pres_bc_rbc_values.
 
+    # -- solid obstacles (volume penalization) -------------------------------
+
+    def set_solid(self, mask, value=None, eta: float | None = None) -> None:
+        """Add a solid obstacle via Brinkman volume penalization.
+
+        ``mask`` (nx, ny): 1 inside the solid, 0 in the fluid, smooth layer in
+        between (models/solid_masks.py builders); ``value``: temperature the
+        solid enforces (default 0); ``eta``: penalty time scale (default
+        dt/10).  The reference stores the mask but never applies it
+        (/root/reference/src/navier_stokes/navier.rs:86); here the step gains
+        an *implicit pointwise* relaxation, solved exactly per sub-step:
+
+            u    <- u / (1 + dt/eta * mask)
+            temp <- (temp + dt/eta * mask * value) / (1 + dt/eta * mask)
+
+        which is unconditionally stable for any eta.  Pass ``mask=None`` to
+        remove the obstacle."""
+        rdt = config.real_dtype()
+        if mask is None:
+            self._solid = None
+            self._compile_entry_points()
+            return
+        mask = np.asarray(mask, dtype=np.float64)
+        if value is None:
+            value = np.zeros_like(mask)
+        if eta is None:
+            eta = self.dt / 10.0
+        a = (self.dt / eta) * mask
+        fac = 1.0 / (1.0 + a)
+        # temp state excludes the BC lift field: target = value - tempbc
+        sp = self.field_space
+        with self._scope():
+            tempbc_phys = np.asarray(sp.backward_ortho(self.tempbc_ortho))
+        temp_add = a * (value - tempbc_phys) * fac
+        self._solid = {
+            "mask": mask,
+            "value": value,
+            "fac": jnp.asarray(fac, dtype=rdt),
+            "temp_add": jnp.asarray(temp_add, dtype=rdt),
+        }
+        self._compile_entry_points()
+
+    @property
+    def solid(self):
+        """Reference-parity accessor: ``model.solid = (mask, value)``
+        (navier.rs:86 ``navier.solid = Some(mask)``)."""
+        if self._solid is None:
+            return None
+        return (self._solid["mask"], self._solid["value"])
+
+    @solid.setter
+    def solid(self, mask_value) -> None:
+        if mask_value is None:
+            self.set_solid(None)
+        else:
+            self.set_solid(mask_value[0], mask_value[1])
+
     # -- initial conditions --------------------------------------------------
 
     def init_random(self, amp: float, seed: int = 0) -> None:
@@ -305,6 +363,7 @@ class Navier2D(Integrate):
             self.solver_temp,
             self.solver_pres,
         )
+        solid = self._solid
 
         def conv(ux, uy, space, vhat, with_bc=False):
             """u . grad(v), dealiased, in scratch-ortho space
@@ -353,6 +412,14 @@ class Navier2D(Integrate):
             rhs = rhs + tb_diff
             rhs = rhs - dt * conv(ux, uy, sp_t, temp, with_bc=True)
             temp_n = sol_t.solve(rhs)
+
+            if solid is not None:
+                # implicit pointwise Brinkman penalization (set_solid):
+                # elementwise in physical space, exact for the sub-step
+                fac, temp_add = solid["fac"], solid["temp_add"]
+                velx_n = sp_u.forward(sp_u.backward(velx_n) * fac)
+                vely_n = sp_v.forward(sp_v.backward(vely_n) * fac)
+                temp_n = sp_t.forward(sp_t.backward(temp_n) * fac + temp_add)
 
             return NavierState(temp_n, velx_n, vely_n, pres_n, pseu_n)
 

@@ -122,7 +122,7 @@ class _SwiftHohenbergBase(Integrate):
                 vhat_c = np.asarray(g["vhat_re"]) + 1j * np.asarray(g["vhat_im"])
             else:
                 vhat_c = np.asarray(g["vhat"])
-            s = self._vhat_from_complex(vhat_c)
+            s = self.space.vhat_from_complex(vhat_c)
             dtype = (
                 config.complex_dtype()
                 if np.iscomplexobj(s)
@@ -184,9 +184,6 @@ class SwiftHohenberg1D(_SwiftHohenbergBase):
             return (theta - dt * cubic) / matl
 
         return step
-
-    def _vhat_from_complex(self, c):
-        return self.space.vhat_from_complex(c)
 
     def _write(self, filename: str) -> None:
         from ..field import grid_deltas
@@ -262,9 +259,6 @@ class SwiftHohenberg2D(_SwiftHohenbergBase):
             return space.enforce_hermitian_x(out)
 
         return step
-
-    def _vhat_from_complex(self, c):
-        return self.space.vhat_from_complex(c)
 
     def pattern_energy(self) -> float:
         """Domain-averaged theta^2 — the pattern-amplitude trace BASELINE
