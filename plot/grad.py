@@ -1,0 +1,55 @@
+"""Plot adjoint / finite-difference gradient fields.
+
+Counterpart of the reference's plot/grad.py: temperature component of
+data/grad_adjoint.h5 (and data/grad_fd.h5 when present) with streamlines of
+the velocity components.
+"""
+
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, __file__.rsplit("/", 1)[0])
+from plot_utils import plot_streamplot  # noqa: E402
+
+
+def _plot_one(filename: str, out: str) -> None:
+    import h5py
+
+    with h5py.File(filename, "r") as f:
+        x = np.asarray(f["temp/x"])
+        y = np.asarray(f["temp/y"])
+        t = np.asarray(f["temp/v"])
+        u = np.asarray(f["ux/v"])
+        v = np.asarray(f["uy/v"])
+    fig, _ = plot_streamplot(x, y, t, u, v, title=filename, return_fig=True)
+    fig.savefig(out, bbox_inches="tight", dpi=200)
+    print(f" ==> {out}")
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--adjoint", default="data/grad_adjoint.h5")
+    ap.add_argument("--fd", default="data/grad_fd.h5")
+    ap.add_argument("--show", action="store_true")
+    args = ap.parse_args()
+
+    import matplotlib
+
+    if not args.show:
+        matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    if os.path.exists(args.adjoint):
+        _plot_one(args.adjoint, "grad_adjoint.png")
+    if os.path.exists(args.fd):
+        _plot_one(args.fd, "grad_fd.png")
+    if args.show:
+        plt.show()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

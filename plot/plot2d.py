@@ -1,0 +1,85 @@
+"""Plot one flow snapshot: temperature + streamlines (+ vorticity/mask).
+
+Counterpart of the reference's plot/plot2d.py over the same HDF5 snapshot
+layout.  Non-interactive by default: pass --index/--file (the reference asks
+on stdin); --list shows the sorted snapshot inventory.
+
+    python plot/plot2d.py --index -1 --out fig.png
+"""
+
+import argparse
+import sys
+
+import numpy as np
+
+sys.path.insert(0, __file__.rsplit("/", 1)[0])
+from plot_utils import (  # noqa: E402
+    plot_contour,
+    plot_streamplot,
+    read_snapshot_fields,
+    sorted_snapshots,
+)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--file", help="snapshot .h5 (overrides --index)")
+    ap.add_argument("--index", type=int, default=-1, help="index into the sorted list")
+    ap.add_argument("--list", action="store_true", help="list snapshots and exit")
+    ap.add_argument("--out", default="fig.png")
+    ap.add_argument("--show", action="store_true")
+    ap.add_argument("--vorticity", action="store_true", help="also plot vorticity")
+    args = ap.parse_args()
+
+    files = sorted_snapshots()
+    if args.list:
+        for i, f in enumerate(files):
+            print(f"# {i:3d}: {f}")
+        return 0
+    filename = args.file or (files[args.index] if files else None)
+    if filename is None:
+        print("no snapshots found (*.h5, data/*.h5)")
+        return 1
+
+    d = read_snapshot_fields(filename)
+    total_temp = d["temp"] + (d["tempbc"] if d["tempbc"] is not None else 0.0)
+    print(f"Plot {filename}  (time={d['time']})")
+
+    import matplotlib
+
+    if not args.show:
+        matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    if d["ux"] is not None:
+        fig, ax = plot_streamplot(
+            d["x"], d["y"], total_temp, d["ux"], d["uy"],
+            title=f"T, t={d['time']:.2f}", return_fig=True,
+        )
+    else:
+        fig, ax = plot_contour(
+            d["x"], d["y"], total_temp, title=f"T, t={d['time']:.2f}",
+            return_fig=True,
+        )
+    if d["mask"] is not None:
+        xx, yy = np.meshgrid(d["x"], d["y"], indexing="ij")
+        ax.contour(xx, yy, d["mask"], levels=[0.5], colors="k", linewidths=1.0)
+    fig.savefig(args.out, bbox_inches="tight", dpi=200)
+    print(f" ==> {args.out}")
+
+    if args.vorticity and d["vorticity"] is not None:
+        fig2, _ = plot_streamplot(
+            d["x"], d["y"], d["vorticity"], d["ux"], d["uy"],
+            title="vorticity", return_fig=True,
+        )
+        out2 = args.out.replace(".png", "_vorticity.png")
+        fig2.savefig(out2, bbox_inches="tight", dpi=200)
+        print(f" ==> {out2}")
+
+    if args.show:
+        plt.show()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

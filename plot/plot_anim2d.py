@@ -1,0 +1,71 @@
+"""Animate a run's snapshots (temperature field over time) to mp4/gif.
+
+Counterpart of the reference's plot/plot_anim2d.py; optionally overlays
+particle trajectories traced by rustpde_mpi_tpu.tools.ParticleSwarm
+(the reference's plot_anim2d_particle.py).
+"""
+
+import argparse
+import sys
+
+import numpy as np
+
+sys.path.insert(0, __file__.rsplit("/", 1)[0])
+from plot_utils import read_snapshot_fields, sorted_snapshots  # noqa: E402
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default="data/anim.gif")
+    ap.add_argument("--duration", type=float, default=10.0, help="seconds")
+    ap.add_argument("--particles", help="trajectory file (time x y rows) to overlay")
+    args = ap.parse_args()
+
+    files = sorted_snapshots()
+    if not files:
+        print("no snapshots found")
+        return 1
+
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+    from matplotlib import animation
+
+    frames = []
+    for f in files:
+        d = read_snapshot_fields(f)
+        total = d["temp"] + (d["tempbc"] if d["tempbc"] is not None else 0.0)
+        frames.append((d["time"], total))
+    x, y = d["x"], d["y"]
+    xx, yy = np.meshgrid(x, y, indexing="ij")
+    amp = max(float(np.nanmax(np.abs(t))) for _, t in frames) or 1.0
+    levels = np.linspace(-amp, amp, 21)
+
+    traj = None
+    if args.particles:
+        rows = np.loadtxt(args.particles, ndmin=2)
+        traj = {t: rows[rows[:, 0] == t, 1:3] for t in np.unique(rows[:, 0])}
+
+    fig, ax = plt.subplots(figsize=(5, 5))
+    ax.set_aspect("equal")
+
+    def draw(i):
+        ax.clear()
+        t, field = frames[i]
+        ax.contourf(xx, yy, field, levels=levels, cmap="RdBu_r")
+        ax.set_title(f"t = {t:.2f}")
+        if traj is not None and t in traj:
+            p = traj[t]
+            ax.plot(p[:, 0], p[:, 1], ".", color="0.1", ms=2)
+        return []
+
+    fps = max(1, int(len(frames) / args.duration))
+    anim = animation.FuncAnimation(fig, draw, frames=len(frames))
+    anim.save(args.out, writer=animation.PillowWriter(fps=fps))
+    print(f" ==> {args.out} ({len(frames)} frames, {fps} fps)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,54 @@
+"""Plot the running-average statistics file (data/statistics.h5).
+
+Counterpart of the reference's plot/plot_statistics.py: mean temperature with
+mean-flow streamlines, and the pointwise Nusselt field.
+"""
+
+import argparse
+import sys
+
+import numpy as np
+
+sys.path.insert(0, __file__.rsplit("/", 1)[0])
+from plot_utils import plot_streamplot  # noqa: E402
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--file", default="data/statistics.h5")
+    ap.add_argument("--out", default="statistics.png")
+    ap.add_argument("--show", action="store_true")
+    args = ap.parse_args()
+
+    import h5py
+
+    with h5py.File(args.file, "r") as f:
+        t = np.asarray(f["temp/v"])
+        u = np.asarray(f["ux/v"])
+        v = np.asarray(f["uy/v"])
+        n = np.asarray(f["nusselt/v"])
+        x = np.asarray(f["temp/x"] if "temp/x" in f else f["x"])
+        y = np.asarray(f["temp/y"] if "temp/y" in f else f["y"])
+
+    import matplotlib
+
+    if not args.show:
+        matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    fig, _ = plot_streamplot(x, y, t, u, v, title="mean T", return_fig=True)
+    fig.savefig(args.out, bbox_inches="tight", dpi=200)
+    print(f" ==> {args.out}")
+    fig2, _ = plot_streamplot(
+        x, y, n, u, v, diverging=False, title="pointwise Nu", return_fig=True
+    )
+    out2 = args.out.replace(".png", "_nusselt.png")
+    fig2.savefig(out2, bbox_inches="tight", dpi=200)
+    print(f" ==> {out2}")
+    if args.show:
+        plt.show()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,151 @@
+"""Shared matplotlib helpers for the post-processing scripts.
+
+Fresh TPU-framework counterpart of the reference's plot/utils/plot_utils.py
+(same role: contour + streamline rendering of snapshot fields on the
+(x, y) tensor grid).  Color policy: signed fields (temperature fluctuation,
+vorticity, adjoint gradients) use a diverging two-hue map centered on zero
+(RdBu_r); magnitudes use a single-hue sequential map (viridis); streamlines
+are drawn in neutral ink so color stays reserved for the scalar field.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def _symmetric_levels(field: np.ndarray, n: int = 21):
+    """Contour levels symmetric about 0 for diverging fields."""
+    amp = float(np.nanmax(np.abs(field)))
+    if amp == 0.0:
+        amp = 1.0
+    return np.linspace(-amp, amp, n)
+
+
+def plot_contour(
+    x,
+    y,
+    field,
+    ax=None,
+    diverging: bool = True,
+    cbar: bool = True,
+    title: str | None = None,
+    return_fig: bool = False,
+):
+    """Filled contour of ``field`` on the (x, y) grid (indexing='ij')."""
+    import matplotlib.pyplot as plt
+
+    if ax is None:
+        fig, ax = plt.subplots(figsize=(5, 5 * (y[-1] - y[0]) / (x[-1] - x[0] + 1e-300)))
+    else:
+        fig = ax.figure
+    xx, yy = np.meshgrid(x, y, indexing="ij")
+    if diverging:
+        levels = _symmetric_levels(field)
+        cmap = "RdBu_r"
+    else:
+        levels = 21
+        cmap = "viridis"
+    im = ax.contourf(xx, yy, field, levels=levels, cmap=cmap)
+    ax.set_aspect("equal")
+    ax.set_xlabel("x")
+    ax.set_ylabel("y")
+    if title:
+        ax.set_title(title)
+    if cbar:
+        fig.colorbar(im, ax=ax, shrink=0.8)
+    if return_fig:
+        return fig, ax
+    return ax
+
+
+def plot_streamplot(
+    x,
+    y,
+    field,
+    u,
+    v,
+    ax=None,
+    diverging: bool = True,
+    cbar: bool = True,
+    density: float = 1.2,
+    title: str | None = None,
+    return_fig: bool = False,
+):
+    """Filled contour of ``field`` with velocity streamlines on top.
+
+    Streamplot requires a uniform grid; the (Chebyshev) fields are resampled
+    onto one by linear interpolation, like the reference's helper."""
+    fig, ax = plot_contour(
+        x, y, field, ax=ax, diverging=diverging, cbar=cbar, title=title,
+        return_fig=True,
+    )
+    if u is not None and v is not None:
+        xi = np.linspace(x[0], x[-1], len(x))
+        yi = np.linspace(y[0], y[-1], len(y))
+        u_i = _resample(x, y, u, xi, yi)
+        v_i = _resample(x, y, v, xi, yi)
+        # streamplot wants (ny, nx) row-major over meshgrid(xi, yi)
+        ax.streamplot(
+            xi,
+            yi,
+            u_i.T,
+            v_i.T,
+            density=density,
+            color="0.25",
+            linewidth=0.8,
+            arrowsize=0.8,
+        )
+    if return_fig:
+        return fig, ax
+    return ax
+
+
+def _resample(x, y, f, xi, yi):
+    """Bilinear resample of f(x, y) onto the (xi, yi) tensor grid."""
+    fx = np.empty((xi.size, y.size))
+    for j in range(y.size):
+        fx[:, j] = np.interp(xi, x, f[:, j])
+    out = np.empty((xi.size, yi.size))
+    for i in range(xi.size):
+        out[i, :] = np.interp(yi, y, fx[i, :])
+    return out
+
+
+def read_snapshot_fields(filename: str):
+    """Read the plotting-relevant datasets of one snapshot; missing groups
+    come back as None (the reference's plot2d.py try/except ladder)."""
+    import h5py
+
+    out = {}
+    with h5py.File(filename, "r") as f:
+        def get(key):
+            return np.asarray(f[key]) if key in f else None
+
+        out["x"] = get("temp/x")
+        out["y"] = get("temp/y")
+        out["temp"] = get("temp/v")
+        out["tempbc"] = get("tempbc/v")
+        out["ux"] = get("ux/v")
+        out["uy"] = get("uy/v")
+        out["pres"] = get("pres/v")
+        out["vorticity"] = get("vorticity/v")
+        out["mask"] = get("solid/mask")
+        out["time"] = float(np.asarray(f["time"])) if "time" in f else None
+    return out
+
+
+def sorted_snapshots(patterns=("*.h5", "data/*.h5")):
+    """Snapshot files sorted by the time embedded in the filename (falling
+    back to mtime), like the reference's glob+regex listing."""
+    import glob
+    import os
+    import re
+
+    files = []
+    for pat in patterns:
+        files.extend(glob.glob(pat))
+    def key(f):
+        m = re.findall(r"\d+\.\d+", os.path.basename(f))
+        return float(m[0]) if m else os.path.getmtime(f)
+
+    return sorted(set(files), key=key)

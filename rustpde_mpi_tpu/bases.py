@@ -845,17 +845,22 @@ class BiPeriodicSpace2:
         return s.at[:, 0, 0].set(0.0)
 
     def enforce_hermitian_x(self, s):
-        """Make the ky=0 column conjugate-symmetric in kx — a real physical
-        field demands c(-kx, 0) = conj(c(kx, 0)); drift breaks the implicit
-        update's stability (/root/reference/examples/swift_hohenberg_2d.rs
-        enforce_hermitian_symmetry)."""
-        col_re, col_im = s[0, :, 0], s[1, :, 0]
+        """Make the self-conjugate ky columns conjugate-symmetric in kx — a
+        real physical field demands c(-kx, ky) = conj(c(kx, ky)) at ky = 0
+        and, for even ny, at the ky-Nyquist column (both map to themselves
+        under ky -> -ky); anti-Hermitian roundoff there is amplified without
+        bound by the diagonal implicit update wherever the mode is linearly
+        unstable.  The reference's helper notes the Nyquist case but fixes
+        only ky=0 (/root/reference/examples/swift_hohenberg_2d.rs
+        enforce_hermitian_symmetry); both columns are projected here."""
         # conjugate pairing index: k -> (nx - k) % nx
         idx = (-jnp.arange(self.nx)) % self.nx
-        sym_re = 0.5 * (col_re + col_re[idx])
-        sym_im = 0.5 * (col_im - col_im[idx])
-        out = s.at[0, :, 0].set(sym_re)
-        return out.at[1, :, 0].set(sym_im)
+        cols = [0] + ([self.my - 1] if self.ny % 2 == 0 else [])
+        for c in cols:
+            sym_re = 0.5 * (s[0, :, c] + s[0, idx, c])
+            sym_im = 0.5 * (s[1, :, c] - s[1, idx, c])
+            s = s.at[0, :, c].set(sym_re).at[1, :, c].set(sym_im)
+        return s
 
     # -- complex interop (checkpoint IO keeps the reference layout) ----------
 

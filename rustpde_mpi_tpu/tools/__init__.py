@@ -1,0 +1,8 @@
+"""Host-side tooling: particle tracer, XDMF/ParaView sidecars.
+
+Rebuilds of the reference's standalone tool crates
+(/root/reference/tools/{particle_tracer,create_xmf_crate}) — native C++ cores
+where the reference's are native Rust, bound via ctypes."""
+
+from .particle_tracer import ParticleSwarm, native_available  # noqa: F401
+from .xdmf import create_xmf, sorted_h5_files  # noqa: F401

@@ -1,0 +1,261 @@
+"""Passive Lagrangian particle tracer.
+
+Rebuild of the reference's ``particle_tracer`` crate
+(/root/reference/tools/particle_tracer/src/lib.rs: ParticleSwarm, RK4 update,
+bilinear interpolation, out-of-bounds freeze).  The hot loop is native C++
+(tools/particle_tracer/tracer.cpp, built on demand with g++) bound through
+ctypes; a vectorized numpy implementation provides the same semantics when no
+compiler is available.  Both paths are tested for equality.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+
+import numpy as np
+
+_TOOLS_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+    "tools",
+    "particle_tracer",
+)
+_LIB_PATH = os.path.join(_TOOLS_DIR, "libtracer.so")
+_lib = None
+_lib_tried = False
+
+
+def _load_native():
+    """Load (building if needed) the C++ core; None if unavailable."""
+    global _lib, _lib_tried
+    if _lib is not None or _lib_tried:
+        return _lib
+    _lib_tried = True
+    if not os.path.exists(_LIB_PATH):
+        src = os.path.join(_TOOLS_DIR, "tracer.cpp")
+        if not os.path.exists(src):
+            return None
+        try:
+            subprocess.run(
+                ["g++", "-O3", "-fPIC", "-shared", src, "-o", _LIB_PATH],
+                check=True,
+                capture_output=True,
+                timeout=120,
+            )
+        except (OSError, subprocess.SubprocessError):
+            return None
+    try:
+        lib = ctypes.CDLL(_LIB_PATH)
+    except OSError:
+        return None
+    dptr = ctypes.POINTER(ctypes.c_double)
+    lib.advect_particles.restype = ctypes.c_long
+    lib.advect_particles.argtypes = [
+        dptr, ctypes.c_long, dptr, ctypes.c_long,
+        dptr, dptr, dptr, dptr, ctypes.c_long,
+        ctypes.c_double, ctypes.c_long,
+    ]
+    lib.sample_velocity.restype = None
+    lib.sample_velocity.argtypes = [
+        dptr, ctypes.c_long, dptr, ctypes.c_long,
+        dptr, dptr, dptr, dptr, ctypes.c_long, dptr, dptr,
+    ]
+    _lib = lib
+    return _lib
+
+
+def native_available() -> bool:
+    return _load_native() is not None
+
+
+def _as_c(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_double))
+
+
+# ---------------------------------------------------------------------------
+# numpy fallback with identical semantics
+# ---------------------------------------------------------------------------
+
+
+def _bilinear(x, y, ux, uy, px, py):
+    """Vectorized bilinear sample at (px, py); positions must be in bounds."""
+    i = np.clip(np.searchsorted(x, px, side="right") - 1, 0, x.size - 2)
+    j = np.clip(np.searchsorted(y, py, side="right") - 1, 0, y.size - 2)
+    tx = (px - x[i]) / (x[i + 1] - x[i])
+    ty = (py - y[j]) / (y[j + 1] - y[j])
+    w00 = (1 - tx) * (1 - ty)
+    w01 = (1 - tx) * ty
+    w10 = tx * (1 - ty)
+    w11 = tx * ty
+
+    def samp(f):
+        return (
+            w00 * f[i, j] + w01 * f[i, j + 1] + w10 * f[i + 1, j] + w11 * f[i + 1, j + 1]
+        )
+
+    return samp(ux), samp(uy)
+
+
+def _inside(x, y, px, py):
+    return (px >= x[0]) & (px <= x[-1]) & (py >= y[0]) & (py <= y[-1])
+
+
+def _advect_numpy(x, y, ux, uy, px, py, dt, n_steps):
+    alive = _inside(x, y, px, py)
+    for _ in range(n_steps):
+        if not alive.any():
+            break
+        cx, cy = px.copy(), py.copy()
+        k1x, k1y = _bilinear(x, y, ux, uy, cx, cy)
+        mx, my = cx + 0.5 * dt * k1x, cy + 0.5 * dt * k1y
+        alive &= _inside(x, y, mx, my)
+        k2x, k2y = _bilinear(x, y, ux, uy, mx, my)
+        mx, my = cx + 0.5 * dt * k2x, cy + 0.5 * dt * k2y
+        alive &= _inside(x, y, mx, my)
+        k3x, k3y = _bilinear(x, y, ux, uy, mx, my)
+        mx, my = cx + dt * k3x, cy + dt * k3y
+        alive &= _inside(x, y, mx, my)
+        k4x, k4y = _bilinear(x, y, ux, uy, mx, my)
+        nx_ = cx + dt / 6.0 * (k1x + 2 * k2x + 2 * k3x + k4x)
+        ny_ = cy + dt / 6.0 * (k1y + 2 * k2y + 2 * k3y + k4y)
+        alive &= _inside(x, y, nx_, ny_)
+        px[alive] = nx_[alive]
+        py[alive] = ny_[alive]
+    return int((~alive).sum())
+
+
+# ---------------------------------------------------------------------------
+# public API
+# ---------------------------------------------------------------------------
+
+
+class ParticleSwarm:
+    """Swarm of passive tracers on a 2-D tensor grid.
+
+    API mirrors the reference (lib.rs ParticleSwarm): construct from explicit
+    positions, a random rectangle, or a file; ``update`` advances through one
+    velocity snapshot; ``trace_files`` replays a whole run of h5 snapshots.
+    """
+
+    def __init__(self, positions, x, y, timestep: float, backend: str = "auto"):
+        self.x = np.ascontiguousarray(x, dtype=np.float64)
+        self.y = np.ascontiguousarray(y, dtype=np.float64)
+        positions = np.asarray(positions, dtype=np.float64)
+        self.px = np.ascontiguousarray(positions[:, 0].copy())
+        self.py = np.ascontiguousarray(positions[:, 1].copy())
+        self.timestep = float(timestep)
+        self.time = 0.0
+        self.history: list[tuple[float, np.ndarray, np.ndarray]] = []
+        if backend == "auto":
+            backend = "native" if native_available() else "numpy"
+        if backend == "native" and not native_available():
+            raise RuntimeError("native tracer library unavailable (g++ build failed?)")
+        self.backend = backend
+
+    # -- constructors (reference lib.rs:78-140) ------------------------------
+
+    @classmethod
+    def from_rectangle(
+        cls, x0, y0, range_, n, x, y, timestep, seed: int = 0, backend="auto"
+    ):
+        rng = np.random.default_rng(seed)
+        pos = np.stack(
+            [
+                x0 + rng.uniform(-range_, range_, n),
+                y0 + rng.uniform(-range_, range_, n),
+            ],
+            axis=1,
+        )
+        return cls(pos, x, y, timestep, backend=backend)
+
+    @classmethod
+    def from_file(cls, fname, x, y, timestep, backend="auto"):
+        """Read ``time x y`` rows (the write() format)."""
+        data = np.loadtxt(fname, ndmin=2)
+        return cls(data[:, 1:3], x, y, timestep, backend=backend)
+
+    # -- evolution ----------------------------------------------------------
+
+    def positions(self) -> np.ndarray:
+        return np.stack([self.px, self.py], axis=1)
+
+    def update(self, ux, uy, n_steps: int = 1) -> int:
+        """Advance ``n_steps`` RK4 steps through one (static) velocity field;
+        returns the number of currently frozen (out-of-bounds) particles."""
+        ux = np.ascontiguousarray(ux, dtype=np.float64)
+        uy = np.ascontiguousarray(uy, dtype=np.float64)
+        if ux.shape != (self.x.size, self.y.size):
+            raise ValueError(f"velocity shape {ux.shape} != grid {(self.x.size, self.y.size)}")
+        if self.backend == "native":
+            frozen = _load_native().advect_particles(
+                _as_c(self.x), self.x.size, _as_c(self.y), self.y.size,
+                _as_c(ux), _as_c(uy), _as_c(self.px), _as_c(self.py),
+                self.px.size, self.timestep, n_steps,
+            )
+        else:
+            frozen = _advect_numpy(
+                self.x, self.y, ux, uy, self.px, self.py, self.timestep, n_steps
+            )
+        self.time += n_steps * self.timestep
+        return int(frozen)
+
+    def sample(self, ux, uy) -> tuple[np.ndarray, np.ndarray]:
+        """Velocity at the current particle positions (0 outside)."""
+        ux = np.ascontiguousarray(ux, dtype=np.float64)
+        uy = np.ascontiguousarray(uy, dtype=np.float64)
+        if self.backend == "native":
+            out_u = np.empty_like(self.px)
+            out_v = np.empty_like(self.py)
+            _load_native().sample_velocity(
+                _as_c(self.x), self.x.size, _as_c(self.y), self.y.size,
+                _as_c(ux), _as_c(uy), _as_c(self.px), _as_c(self.py),
+                self.px.size, _as_c(out_u), _as_c(out_v),
+            )
+            return out_u, out_v
+        inside = _inside(self.x, self.y, self.px, self.py)
+        u = np.zeros_like(self.px)
+        v = np.zeros_like(self.py)
+        if inside.any():
+            su, sv = _bilinear(
+                self.x, self.y, ux, uy, self.px[inside], self.py[inside]
+            )
+            u[inside], v[inside] = su, sv
+        return u, v
+
+    def record(self) -> None:
+        self.history.append((self.time, self.px.copy(), self.py.copy()))
+
+    def trace_files(
+        self, files, snapshot_dt: float, ux_key="ux/v", uy_key="uy/v",
+        record_every: int = 1,
+    ) -> None:
+        """Replay a run: for each snapshot file advance snapshot_dt worth of
+        RK4 steps through its (frozen) velocity field, recording positions
+        (the reference's main.rs driver loop)."""
+        import h5py
+
+        steps_per_file = max(1, round(snapshot_dt / self.timestep))
+        self.record()
+        for idx, fname in enumerate(files):
+            with h5py.File(fname, "r") as f:
+                ux = np.asarray(f[ux_key])
+                uy = np.asarray(f[uy_key])
+            self.update(ux, uy, steps_per_file)
+            if (idx + 1) % record_every == 0:
+                self.record()
+
+    # -- IO (reference lib.rs write: "time x y" rows) ------------------------
+
+    def write(self, fname: str) -> None:
+        """Current positions, one ``time x y`` row per particle."""
+        with open(fname, "w") as f:
+            for xp, yp in zip(self.px, self.py):
+                f.write(f"{self.time} {xp} {yp}\n")
+
+    def write_history(self, fname: str) -> None:
+        """Recorded trajectory: blocks of ``time x y`` per record call."""
+        with open(fname, "w") as f:
+            for t, xs, ys in self.history:
+                for xp, yp in zip(xs, ys):
+                    f.write(f"{t} {xp} {yp}\n")

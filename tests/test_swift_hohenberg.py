@@ -155,6 +155,25 @@ def test_biperiodic_hermitian_projection():
     fixed = np.asarray(space.enforce_hermitian_x(bad))
     np.testing.assert_allclose(fixed[0, 3, 0], fixed[0, nx - 3, 0], atol=1e-12)
     np.testing.assert_allclose(fixed[1, 3, 0], -fixed[1, nx - 3, 0], atol=1e-12)
+    # the ky-Nyquist column (even ny) is self-conjugate too — anti-Hermitian
+    # drift there must also be projected out
+    nyq = space.my - 1
+    bad2 = s.at[1, 2, nyq].add(0.5)
+    fixed2 = np.asarray(space.enforce_hermitian_x(bad2))
+    np.testing.assert_allclose(fixed2[1, 2, nyq], -fixed2[1, nx - 2, nyq], atol=1e-12)
+
+
+def test_sh2d_nyquist_unstable_mode_stays_bounded():
+    """ny/2 / length near k=1 makes the ky-Nyquist modes linearly unstable
+    (matl < 1): without the Nyquist Hermitian projection, anti-Hermitian
+    roundoff there grows ~(1/matl)^n and the run eventually NaNs."""
+    model = SwiftHohenberg2D(16, 16, r=0.35, dt=0.05, length=8.0)
+    k_nyq = (model.ny // 2) / model.scale[1]
+    matl_nyq = 1.0 + model.dt * ((1.0 - k_nyq**2) ** 2 - model.r)
+    assert matl_nyq < 1.0  # config genuinely exercises the unstable column
+    model.update_n(4000)
+    assert not model.exit()
+    assert np.max(np.abs(model.theta_physical())) < 2.0
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,119 @@
+// Passive Lagrangian particle tracer — native core.
+//
+// TPU-framework rebuild of the reference's particle_tracer crate
+// (/root/reference/tools/particle_tracer/src/lib.rs): RK4 advection of a
+// particle swarm through 2-D velocity snapshots with bilinear interpolation
+// on a (possibly non-uniform, e.g. Chebyshev) tensor grid.  The runtime is
+// host-side tooling, so it is native C++ like the reference's Rust crate;
+// rustpde_mpi_tpu/tools/particle_tracer.py binds it via ctypes (with a numpy
+// fallback when the shared library has not been built).
+//
+// Build:  make            (g++ -O3 -shared -fPIC tracer.cpp -o libtracer.so)
+//
+// Conventions: fields are row-major (nx, ny); grids ascending; a particle
+// whose trajectory leaves the domain freezes in place for the remainder of
+// the call (the reference ignores the out-of-bounds error per step,
+// lib.rs ParticleSwarm::update).
+
+#include <algorithm>
+#include <cstdint>
+
+namespace {
+
+// index of the grid interval containing p: returns i with g[i] <= p < g[i+1],
+// clamped to [0, n-2]
+inline long interval(const double* g, long n, double p) {
+    const double* it = std::upper_bound(g, g + n, p);
+    long hi = static_cast<long>(it - g);
+    if (hi <= 0) hi = 1;
+    if (hi >= n) hi = n - 1;
+    return hi - 1;
+}
+
+struct Grid {
+    const double* x;
+    long nx;
+    const double* y;
+    long ny;
+    const double* ux;  // (nx, ny) row-major
+    const double* uy;
+
+    bool inside(double px, double py) const {
+        return px >= x[0] && px <= x[nx - 1] && py >= y[0] && py <= y[ny - 1];
+    }
+
+    // bilinear sample of (ux, uy) at (px, py)
+    void sample(double px, double py, double* out) const {
+        long i = interval(x, nx, px);
+        long j = interval(y, ny, py);
+        double dx = x[i + 1] - x[i];
+        double dy = y[j + 1] - y[j];
+        double tx = (px - x[i]) / dx;
+        double ty = (py - y[j]) / dy;
+        double w00 = (1.0 - tx) * (1.0 - ty);
+        double w01 = (1.0 - tx) * ty;
+        double w10 = tx * (1.0 - ty);
+        double w11 = tx * ty;
+        long base = i * ny + j;
+        out[0] = w00 * ux[base] + w01 * ux[base + 1] + w10 * ux[base + ny] +
+                 w11 * ux[base + ny + 1];
+        out[1] = w00 * uy[base] + w01 * uy[base + 1] + w10 * uy[base + ny] +
+                 w11 * uy[base + ny + 1];
+    }
+};
+
+}  // namespace
+
+extern "C" {
+
+// Advance all particles n_steps RK4 steps of size dt through the (static)
+// velocity field.  Positions are updated in place; out-of-bounds particles
+// freeze.  Returns the number of particles frozen at exit.
+long advect_particles(const double* x, long nx, const double* y, long ny,
+                      const double* ux, const double* uy, double* px,
+                      double* py, long n_particles, double dt, long n_steps) {
+    Grid grid{x, nx, y, ny, ux, uy};
+    long frozen = 0;
+    for (long p = 0; p < n_particles; ++p) {
+        double cx = px[p], cy = py[p];
+        bool alive = grid.inside(cx, cy);
+        for (long s = 0; s < n_steps && alive; ++s) {
+            double k1[2], k2[2], k3[2], k4[2];
+            grid.sample(cx, cy, k1);
+            double mx = cx + 0.5 * dt * k1[0], my = cy + 0.5 * dt * k1[1];
+            if (!grid.inside(mx, my)) { alive = false; break; }
+            grid.sample(mx, my, k2);
+            mx = cx + 0.5 * dt * k2[0];
+            my = cy + 0.5 * dt * k2[1];
+            if (!grid.inside(mx, my)) { alive = false; break; }
+            grid.sample(mx, my, k3);
+            mx = cx + dt * k3[0];
+            my = cy + dt * k3[1];
+            if (!grid.inside(mx, my)) { alive = false; break; }
+            grid.sample(mx, my, k4);
+            cx += dt / 6.0 * (k1[0] + 2.0 * k2[0] + 2.0 * k3[0] + k4[0]);
+            cy += dt / 6.0 * (k1[1] + 2.0 * k2[1] + 2.0 * k3[1] + k4[1]);
+            if (!grid.inside(cx, cy)) { alive = false; break; }
+        }
+        px[p] = cx;
+        py[p] = cy;
+        if (!alive) ++frozen;
+    }
+    return frozen;
+}
+
+// Single bilinear sample (exposed for tests / probing snapshots from Python).
+void sample_velocity(const double* x, long nx, const double* y, long ny,
+                     const double* ux, const double* uy, const double* px,
+                     const double* py, long n, double* out_ux,
+                     double* out_uy) {
+    Grid grid{x, nx, y, ny, ux, uy};
+    for (long p = 0; p < n; ++p) {
+        double u[2] = {0.0, 0.0};
+        if (grid.inside(px[p], py[p])) grid.sample(px[p], py[p], u);
+        out_ux[p] = u[0];
+        out_uy[p] = u[1];
+    }
+}
+
+}  // extern "C"
