@@ -1,19 +1,24 @@
-"""Benchmark: timesteps/sec of the confined 2-D RBC DNS at 1025^2.
+"""Benchmark harness: the 5 BASELINE.json configs + MFU estimate.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line whose required fields are
+``{"metric", "value", "unit", "vs_baseline"}`` (primary metric: timesteps/sec
+of the confined 2-D RBC DNS at 1025^2, BASELINE config #4); the same object
+carries the full config matrix under ``"configs"`` and an ``"mfu"`` estimate,
+and the matrix is also written to BENCH_FULL.json.
 
-Config follows BASELINE.json #4 (1025^2, Ra=1e9).  Runs f32 on the TPU by
-default (RUSTPDE_X64=0); override via env:
+Environment knobs:
 
-    RUSTPDE_BENCH_NX     grid size              (default 1025)
-    RUSTPDE_BENCH_STEPS  timed steps            (default 64)
-    RUSTPDE_X64          1 for f64 parity mode  (default 0 here)
+    RUSTPDE_BENCH_CONFIGS  comma list / "all" (default) /
+                           names: rbc129, periodic, poisson1025, rbc1025,
+                                  sh2048, rbc2049, rbc129_f64
+    RUSTPDE_BENCH_STEPS    timed steps for the primary config (default 64)
+    RUSTPDE_X64            1 for f64 parity mode (default 0 here)
 
 ``vs_baseline``: the reference publishes no numbers and cannot be built in
-this container (no Rust toolchain), so the recorded baseline is this
-framework's own CPU path (f64, banded solvers — algorithmically the
-reference's serial configuration) measured on this host at the same config;
-see BASELINE.md "Measured stand-in baseline".
+this container (no Rust toolchain), so the denominator is this framework's
+own CPU path (f64, banded solvers — algorithmically the reference's serial
+configuration) measured on this host at the same 1025^2 config; see
+BASELINE.md "Measured stand-in baseline".
 """
 
 import json
@@ -25,54 +30,170 @@ os.environ.setdefault("RUSTPDE_X64", "0")
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 # CPU f64 banded-path steps/s at 1025^2 Ra=1e9 measured on this container's
-# host CPU, 2026-07-29 (see BASELINE.md "Measured stand-in baseline"); the
-# denominator for vs_baseline.
+# host CPU, 2026-07-29 (BASELINE.md "Measured stand-in baseline").
 CPU_BASELINE_STEPS_PER_SEC = 0.188
+
+DEFAULT_CONFIGS = [
+    "rbc129",
+    "rbc129_f64",
+    "periodic",
+    "poisson1025",
+    "rbc1025",
+    "sh2048",
+]
+
+
+def bench_navier(nx, ny, ra, dt, steps, periodic=False, x64=None):
+    from rustpde_mpi_tpu import Navier2D
+    from rustpde_mpi_tpu.utils.profiling import benchmark_steps, mfu_estimate
+
+    ctor = Navier2D.new_periodic if periodic else Navier2D.new_confined
+    model = ctor(nx, ny, ra, 1.0, dt, 1.0, "rbc")
+    res = benchmark_steps(model, steps)
+    nu, _, _, div = model.get_observables()
+    res["nu"] = nu
+    res["finite"] = bool(nu == nu and div == div)
+    res["mfu"] = mfu_estimate(model, res["steps_per_sec"])
+    return res
+
+
+def bench_poisson(n, solves=32):
+    """Standalone Poisson solve rate + MMS max error (BASELINE config #3,
+    /root/reference/examples/poisson_mpi.rs analog)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from rustpde_mpi_tpu import Space2, cheb_neumann
+    from rustpde_mpi_tpu.solver import Poisson
+
+    space = Space2(cheb_neumann(n), cheb_neumann(n))
+    solver = Poisson(space, (1.0, 1.0))
+    xs, ys = (b.points for b in space.bases)
+    # Neumann-compatible zero-mean MMS mode (tests/test_solver.py convention)
+    u = np.cos(np.pi * xs)[:, None] * np.cos(np.pi * ys)[None, :]
+    f = -2.0 * np.pi**2 * u
+    fhat_ortho = space.to_ortho(space.forward(jnp.asarray(f)))
+
+    solve = jax.jit(solver.solve)
+    out = solve(fhat_ortho)
+    got = np.array(space.backward(out))
+    got -= got.mean() - u.mean()  # defined up to a constant
+    err = float(np.abs(got - u).max())
+    np.asarray(out[:1, :1])
+    t0 = time.perf_counter()
+    for _ in range(solves):
+        out = solve(fhat_ortho)
+    np.asarray(out[:1, :1])
+    elapsed = time.perf_counter() - t0
+    return {"solves_per_sec": solves / elapsed, "max_error": err, "n": n}
+
+
+def bench_sh(nx, steps=32):
+    from rustpde_mpi_tpu import SwiftHohenberg2D
+    from rustpde_mpi_tpu.utils.profiling import benchmark_steps
+
+    model = SwiftHohenberg2D(nx, nx, r=0.35, dt=0.02, length=20.0)
+    res = benchmark_steps(model, steps)
+    res["pattern_energy"] = model.pattern_energy()
+    res["finite"] = not model.exit()
+    return res
 
 
 def main() -> int:
     import jax
 
-    from rustpde_mpi_tpu import Navier2D
-
-    nx = int(os.environ.get("RUSTPDE_BENCH_NX", "1025"))
+    platform = jax.devices()[0].platform
+    sel = os.environ.get("RUSTPDE_BENCH_CONFIGS", "all")
+    names = DEFAULT_CONFIGS if sel == "all" else [s.strip() for s in sel.split(",")]
     steps = int(os.environ.get("RUSTPDE_BENCH_STEPS", "64"))
 
-    import numpy as np
+    results: dict[str, dict] = {}
+    ok = True
+    for name in names:
+        t0 = time.perf_counter()
+        try:
+            if name == "rbc129":
+                r = bench_navier(129, 129, 1e7, 2e-3, steps)
+            elif name == "rbc129_f64":
+                env = dict(os.environ, RUSTPDE_X64="1")
+                import subprocess
 
-    def sync(m):
-        # a data readback, not just block_until_ready: the axon TPU relay's
-        # dispatch is async past block_until_ready, so only materializing
-        # bytes on the host guarantees the computation finished
-        return np.asarray(m.state.temp[:1, :1])
+                code = (
+                    "import bench, json;"
+                    "print(json.dumps(bench.bench_navier(129,129,1e7,2e-3,32)))"
+                )
+                out = subprocess.run(
+                    [sys.executable, "-c", code],
+                    capture_output=True, text=True, env=env, timeout=1800,
+                    cwd=os.path.dirname(os.path.abspath(__file__)),
+                )
+                r = json.loads(out.stdout.strip().splitlines()[-1])
+            elif name == "periodic":
+                r = bench_navier(128, 65, 1e6, 1e-2, steps, periodic=True)
+            elif name == "poisson1025":
+                r = bench_poisson(1025)
+            elif name == "rbc1025":
+                r = bench_navier(1025, 1025, 1e9, 1e-4, steps)
+            elif name == "rbc2049":
+                r = bench_navier(2049, 2049, 1e9, 5e-5, max(16, steps // 4))
+            elif name == "sh2048":
+                r = bench_sh(2048)
+            else:
+                print(f"unknown config {name}", file=sys.stderr)
+                continue
+            r["bench_wall_s"] = round(time.perf_counter() - t0, 1)
+            results[name] = r
+            ok = ok and r.get("finite", True)
+        except Exception as exc:  # record the failure, keep benching
+            results[name] = {"error": f"{type(exc).__name__}: {exc}"}
+            ok = False
+        print(f"# {name}: {results[name]}", file=sys.stderr)
 
-    model = Navier2D.new_confined(nx, nx, 1e9, 1.0, 1e-4, 1.0, "rbc")
-    model.update_n(steps)  # compile the exact bucket sequence + warm up
-    sync(model)
-
-    t0 = time.perf_counter()
-    model.update_n(steps)
-    sync(model)
-    elapsed = time.perf_counter() - t0
-
-    value = steps / elapsed
-    nu, _, _, div = model.get_observables()
-    ok = all(map(lambda v: v == v, (nu, div)))  # NaN guard
-
-    vs = value / CPU_BASELINE_STEPS_PER_SEC if CPU_BASELINE_STEPS_PER_SEC else 0.0
-    print(
-        json.dumps(
-            {
-                "metric": f"timesteps/sec, 2D RBC confined {nx}x{nx} Ra=1e9 "
-                f"({'f64' if os.environ.get('RUSTPDE_X64') == '1' else 'f32'}, "
-                f"{jax.devices()[0].platform})",
-                "value": round(value, 3),
-                "unit": "steps/s",
-                "vs_baseline": round(vs, 2),
-            }
-        )
+    # primary metric: rbc1025 when selected, else the first config that
+    # reports a rate (a subset run must not report failure just because the
+    # primary config was excluded)
+    primary_name = "rbc1025" if "rbc1025" in results else next(
+        (k for k, v in results.items() if "steps_per_sec" in v), None
     )
-    return 0 if ok else 1
+    primary = results.get(primary_name, {})
+    value = primary.get("steps_per_sec", 0.0)
+    # the CPU stand-in baseline is measured at the 1025^2 config only
+    vs = (
+        value / CPU_BASELINE_STEPS_PER_SEC if primary_name == "rbc1025" else 0.0
+    )
+    mfu = primary.get("mfu", {}).get("mfu")
+
+    metric_names = {
+        "rbc1025": "2D RBC confined 1025x1025 Ra=1e9",
+        "rbc2049": "2D RBC confined 2049x2049 Ra=1e9",
+        "rbc129": "2D RBC confined 129x129 Ra=1e7",
+        "rbc129_f64": "2D RBC confined 129x129 Ra=1e7 (f64)",
+        "periodic": "2D RBC periodic 128x65 Ra=1e6",
+        "sh2048": "Swift-Hohenberg 2048x2048",
+    }
+    payload = {
+        "metric": (
+            f"timesteps/sec, {metric_names.get(primary_name, primary_name)} "
+            f"({'f64' if os.environ.get('RUSTPDE_X64') == '1' else 'f32'}, {platform})"
+        ),
+        "value": round(value, 3),
+        "unit": "steps/s",
+        "vs_baseline": round(vs, 2),
+        "mfu": round(mfu, 4) if mfu is not None else None,
+        "configs": {
+            k: {
+                kk: (round(vv, 4) if isinstance(vv, float) else vv)
+                for kk, vv in v.items()
+                if kk != "mfu"
+            }
+            for k, v in results.items()
+        },
+    }
+    with open("BENCH_FULL.json", "w") as f:
+        json.dump({"platform": platform, "results": results}, f, indent=1, default=str)
+    print(json.dumps(payload))
+    return 0 if ok and value > 0 else 1
 
 
 if __name__ == "__main__":

@@ -1,0 +1,68 @@
+"""Steady-state continuation in Rayleigh number.
+
+Working version of /root/reference/examples/navier_rbc_steady_continuation.rs
+(a commented-out stub in the reference): walk a log-spaced Ra list, solving
+for the steady state at each Ra with the adjoint descent solver
+(Navier2DAdjoint), warm-starting every solve from the previous Ra's converged
+field, and record the Nu(Ra) continuation curve.
+"""
+
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from rustpde_mpi_tpu import Navier2DAdjoint, integrate
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--nx", type=int, default=33)
+    ap.add_argument("--ny", type=int, default=33)
+    ap.add_argument("--ra-start", type=float, default=1e4)
+    ap.add_argument("--ra-stop", type=float, default=10 ** 4.2)
+    ap.add_argument("--num", type=int, default=3)
+    # the reference's commented continuation stub uses dt=0.5 on a 128x65
+    # periodic grid; the confined 33^2 descent here needs the steady
+    # example's small pseudo-step (examples/navier_rbc_steady.py, dt=5e-3)
+    ap.add_argument("--dt", type=float, default=5e-3)
+    ap.add_argument("--max-time", type=float, default=100.0)
+    ap.add_argument("--out", default="data/continuation.txt")
+    args = ap.parse_args()
+
+    ra_list = np.logspace(np.log10(args.ra_start), np.log10(args.ra_stop), args.num)
+    os.makedirs("data", exist_ok=True)
+    restart = None
+    rows = []
+    for ra in ra_list:
+        print(f"\n=== Ra = {ra:.3e} ===")
+        navier = Navier2DAdjoint.new_confined(
+            args.nx, args.ny, float(ra), 1.0, args.dt, 1.0, "rbc"
+        )
+        if restart is not None:
+            navier.read(restart)
+            navier.reset_time()
+        else:
+            navier.set_temperature(0.2, 1.0, 1.0)
+            navier.set_velocity(0.2, 1.0, 1.0)
+        integrate(navier, args.max_time, args.max_time / 4.0)
+        fname = f"data/steady_ra{ra:4.2e}.h5"
+        navier.write(fname)
+        restart = fname
+        nu, nuvol, re, _div = navier.get_observables()
+        res = navier.residual()
+        rows.append((ra, nu, nuvol, re, res))
+        print(f"Ra={ra:.3e}: Nu={nu:.6f} Nuvol={nuvol:.6f} Re={re:.4f} res={res:.2e}")
+
+    with open(args.out, "w") as f:
+        for row in rows:
+            f.write("  ".join(f"{v:8.6e}" for v in row) + "\n")
+    print(f"\n ==> {args.out}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

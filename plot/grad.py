@@ -11,7 +11,7 @@ import sys
 
 import numpy as np
 
-sys.path.insert(0, __file__.rsplit("/", 1)[0])
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 from plot_utils import plot_streamplot  # noqa: E402
 
 

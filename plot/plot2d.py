@@ -8,11 +8,12 @@ on stdin); --list shows the sorted snapshot inventory.
 """
 
 import argparse
+import os
 import sys
 
 import numpy as np
 
-sys.path.insert(0, __file__.rsplit("/", 1)[0])
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 from plot_utils import (  # noqa: E402
     plot_contour,
     plot_streamplot,

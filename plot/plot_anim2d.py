@@ -6,11 +6,12 @@ particle trajectories traced by rustpde_mpi_tpu.tools.ParticleSwarm
 """
 
 import argparse
+import os
 import sys
 
 import numpy as np
 
-sys.path.insert(0, __file__.rsplit("/", 1)[0])
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 from plot_utils import read_snapshot_fields, sorted_snapshots  # noqa: E402
 
 
@@ -45,7 +46,19 @@ def main() -> int:
     traj = None
     if args.particles:
         rows = np.loadtxt(args.particles, ndmin=2)
-        traj = {t: rows[rows[:, 0] == t, 1:3] for t in np.unique(rows[:, 0])}
+        traj_times = np.unique(rows[:, 0])
+        traj = {t: rows[rows[:, 0] == t, 1:3] for t in traj_times}
+
+        def traj_at(t):
+            """Trajectory block nearest to the frame time (the two time axes
+            are accumulated independently, so exact equality never holds)."""
+            if len(traj_times) == 0:
+                return None
+            i = int(np.argmin(np.abs(traj_times - t)))
+            dt_typ = np.median(np.diff(traj_times)) if len(traj_times) > 1 else np.inf
+            if abs(traj_times[i] - t) <= dt_typ / 2.0 + 1e-9:
+                return traj[traj_times[i]]
+            return None
 
     fig, ax = plt.subplots(figsize=(5, 5))
     ax.set_aspect("equal")
@@ -55,9 +68,10 @@ def main() -> int:
         t, field = frames[i]
         ax.contourf(xx, yy, field, levels=levels, cmap="RdBu_r")
         ax.set_title(f"t = {t:.2f}")
-        if traj is not None and t in traj:
-            p = traj[t]
-            ax.plot(p[:, 0], p[:, 1], ".", color="0.1", ms=2)
+        if traj is not None:
+            p = traj_at(t)
+            if p is not None:
+                ax.plot(p[:, 0], p[:, 1], ".", color="0.1", ms=2)
         return []
 
     fps = max(1, int(len(frames) / args.duration))

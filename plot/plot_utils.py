@@ -135,17 +135,32 @@ def read_snapshot_fields(filename: str):
 
 
 def sorted_snapshots(patterns=("*.h5", "data/*.h5")):
-    """Snapshot files sorted by the time embedded in the filename (falling
-    back to mtime), like the reference's glob+regex listing."""
+    """Flow-snapshot files sorted by their stored time scalar (filename time
+    as fallback).  Non-snapshot h5 files in the same directory (e.g.
+    data/statistics.h5, cartesian.nc sidecars) are excluded by requiring the
+    ``temp/v`` dataset + a ``time`` scalar."""
     import glob
     import os
     import re
 
+    import h5py
+
     files = []
     for pat in patterns:
         files.extend(glob.glob(pat))
-    def key(f):
-        m = re.findall(r"\d+\.\d+", os.path.basename(f))
-        return float(m[0]) if m else os.path.getmtime(f)
-
-    return sorted(set(files), key=key)
+    keyed = []
+    for f in sorted(set(files)):
+        try:
+            with h5py.File(f, "r") as h5:
+                if "temp/v" not in h5:
+                    continue
+                if "time" in h5:
+                    t = float(np.asarray(h5["time"]))
+                else:
+                    m = re.findall(r"\d+\.\d+", os.path.basename(f))
+                    t = float(m[0]) if m else 0.0
+        except OSError:
+            continue
+        keyed.append((t, f))
+    keyed.sort(key=lambda p: p[0])
+    return [f for _, f in keyed]

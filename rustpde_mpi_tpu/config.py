@@ -60,3 +60,32 @@ def supports_complex() -> bool:
     FFT); spectral pipelines there must run real-valued matmul transforms,
     with Fourier axes in a split re/im representation."""
     return not is_tpu_like()
+
+
+from dataclasses import dataclass, field
+
+
+@dataclass
+class NavierConfig:
+    """Configuration dataclass for the Navier models (SURVEY.md S5: the
+    reference passes bare constructor arguments and mutates public fields,
+    navier.rs:229-233; this names the same vocabulary in one object).
+
+    Use with ``Navier2D.from_config(cfg)`` / ``Navier2DAdjoint.from_config``.
+    """
+
+    nx: int = 129
+    ny: int = 129
+    ra: float = 1e7
+    pr: float = 1.0
+    dt: float = 2e-3
+    aspect: float = 1.0
+    bc: str = "rbc"  # "rbc" | "hc"
+    periodic: bool = False
+    # post-construction knobs (public-field mutation in the reference)
+    write_intervall: float | None = None
+    init_random_amp: float | None = 0.1
+    params: dict = field(default_factory=dict)  # extra params recorded to h5
+
+    def ctor_args(self) -> tuple:
+        return (self.nx, self.ny, self.ra, self.pr, self.dt, self.aspect, self.bc)

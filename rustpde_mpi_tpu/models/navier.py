@@ -92,6 +92,9 @@ class Navier2D(Integrate):
         self.statistics = None
         self._obs_cache: tuple | None = None
         self._solid = None  # (penalization factors) set via set_solid()
+        # diagnostics history appended by the IO callback — the map the
+        # reference allocates but never writes (navier.rs:81)
+        self.diagnostics: dict[str, list[float]] = {}
 
         x_base = fourier_r2c if periodic else cheb_dirichlet
         x_full = fourier_r2c if periodic else chebyshev
@@ -225,6 +228,16 @@ class Navier2D(Integrate):
         (/root/reference/src/navier_stokes/navier.rs:336-428)."""
         model = cls(nx, ny, ra, pr, dt, aspect, bc, periodic=True, mesh=mesh)
         model.init_random(0.1)
+        return model
+
+    @classmethod
+    def from_config(cls, cfg, mesh=None) -> "Navier2D":
+        """Construct from a :class:`~rustpde_mpi_tpu.config.NavierConfig`."""
+        model = cls(*cfg.ctor_args(), periodic=cfg.periodic, mesh=mesh)
+        if cfg.init_random_amp:
+            model.init_random(cfg.init_random_amp)
+        model.write_intervall = cfg.write_intervall
+        model.params.update(cfg.params)
         return model
 
     def _build_bc_fields(self, xs: np.ndarray, ys: np.ndarray) -> None:

@@ -111,6 +111,13 @@ class Navier2DAdjoint(Integrate):
     def new_periodic(cls, nx, ny, ra, pr, dt, aspect, bc, mesh=None) -> "Navier2DAdjoint":
         return cls(nx, ny, ra, pr, dt, aspect, bc, periodic=True, mesh=mesh)
 
+    @classmethod
+    def from_config(cls, cfg, mesh=None) -> "Navier2DAdjoint":
+        """Construct from a :class:`~rustpde_mpi_tpu.config.NavierConfig`."""
+        model = cls(*cfg.ctor_args(), periodic=cfg.periodic, mesh=mesh)
+        model.write_intervall = cfg.write_intervall
+        return model
+
     # -- the adjoint iteration ------------------------------------------------
 
     def _make_step(self):

@@ -185,8 +185,9 @@ class ParticleSwarm:
         returns the number of currently frozen (out-of-bounds) particles."""
         ux = np.ascontiguousarray(ux, dtype=np.float64)
         uy = np.ascontiguousarray(uy, dtype=np.float64)
-        if ux.shape != (self.x.size, self.y.size):
-            raise ValueError(f"velocity shape {ux.shape} != grid {(self.x.size, self.y.size)}")
+        grid = (self.x.size, self.y.size)
+        if ux.shape != grid or uy.shape != grid:
+            raise ValueError(f"velocity shapes {ux.shape}/{uy.shape} != grid {grid}")
         if self.backend == "native":
             frozen = _load_native().advect_particles(
                 _as_c(self.x), self.x.size, _as_c(self.y), self.y.size,

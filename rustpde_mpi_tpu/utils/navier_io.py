@@ -49,6 +49,12 @@ def callback(
     if suppress_io:
         return
     nu, nuvol, re, div = model.get_observables()
+    # in-memory diagnostics map — the hook the reference allocates but never
+    # fills (/root/reference/src/navier_stokes/navier.rs:81)
+    diag = getattr(model, "diagnostics", None)
+    if diag is not None:
+        for key, val in (("time", t), ("nu", nu), ("nuvol", nuvol), ("re", re), ("div", div)):
+            diag.setdefault(key, []).append(float(val))
     line = (
         f"time = {t:9.3f}      |div| = {div:4.2e}      "
         f"Nu = {nu:5.3e}      Nuv = {nuvol:5.3e}      Re = {re:5.3e}"

@@ -1,0 +1,182 @@
+"""Profiling and benchmarking utilities.
+
+Fills the reference's observability gap (SURVEY.md S5: wall-clock timing was
+manual ``Instant`` prints, /root/reference/src/main.rs:27-33; no tracing):
+
+* :func:`benchmark_steps` — the one honest way to time steps on the axon TPU
+  (readback sync; ``block_until_ready`` alone measures dispatch).
+* :class:`StepTimer` — lightweight per-chunk timing history a driver loop or
+  callback can sample (the per-step timing API).
+* :func:`trace` — ``jax.profiler`` trace context for XLA-level profiles.
+* :func:`step_flops` / :func:`mfu_estimate` — XLA cost-analysis FLOPs of one
+  model step (analytic GEMM-count fallback) and the resulting model-flops
+  utilization against the chip's peak.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+
+import numpy as np
+
+
+def _sync(model) -> None:
+    """Materialize one element on the host — the only reliable barrier
+    through the axon TPU relay (see bench.py / SKILL.md gotcha)."""
+    if hasattr(model, "state"):
+        np.asarray(model.state.temp[:1, :1])
+    else:  # models without .state (e.g. Swift-Hohenberg) expose .theta
+        np.asarray(model.theta[..., :1, :1])
+
+
+def benchmark_steps(model, steps: int, warmup: int | None = None) -> dict:
+    """Time ``model.update_n``: compile+warm with one full-length run, then
+    measure.  Returns {steps_per_sec, ms_per_step, elapsed_s, steps}."""
+    if warmup is None:
+        warmup = steps
+    if warmup:
+        model.update_n(warmup)
+        _sync(model)
+    t0 = time.perf_counter()
+    model.update_n(steps)
+    _sync(model)
+    elapsed = time.perf_counter() - t0
+    return {
+        "steps_per_sec": steps / elapsed,
+        "ms_per_step": 1e3 * elapsed / steps,
+        "elapsed_s": elapsed,
+        "steps": steps,
+    }
+
+
+class StepTimer:
+    """Rolling per-chunk step-rate history.
+
+    Use from a driver loop:  ``timer.tick(n_steps)`` after each dispatch;
+    ``timer.summary()`` gives mean/min/max steps/s over the recorded chunks.
+    """
+
+    def __init__(self):
+        self.history: list[tuple[int, float]] = []  # (steps, seconds)
+        self._last = time.perf_counter()
+
+    def reset(self) -> None:
+        self._last = time.perf_counter()
+
+    def tick(self, steps: int) -> float:
+        now = time.perf_counter()
+        dt = now - self._last
+        self._last = now
+        self.history.append((steps, dt))
+        return steps / dt if dt > 0 else float("inf")
+
+    def summary(self) -> dict:
+        if not self.history:
+            return {"chunks": 0}
+        rates = [s / t for s, t in self.history if t > 0]
+        return {
+            "chunks": len(self.history),
+            "steps": sum(s for s, _ in self.history),
+            "seconds": sum(t for _, t in self.history),
+            "steps_per_sec_mean": float(np.mean(rates)),
+            "steps_per_sec_min": float(np.min(rates)),
+            "steps_per_sec_max": float(np.max(rates)),
+        }
+
+
+@contextlib.contextmanager
+def trace(logdir: str = "/tmp/jax-trace"):
+    """``jax.profiler`` trace context (view with TensorBoard/XProf/Perfetto).
+    Falls back to a no-op if the backend cannot be traced (the axon relay
+    does not export device traces)."""
+    import jax
+
+    started = False
+    try:
+        jax.profiler.start_trace(logdir)
+        started = True
+    except Exception as exc:  # relay backends may refuse
+        print(f"profiler trace unavailable: {exc}")
+    try:
+        yield logdir
+    finally:
+        if started:
+            try:
+                jax.profiler.stop_trace()
+                print(f"profile written to {logdir}")
+            except Exception as exc:
+                print(f"profiler stop failed: {exc}")
+
+
+# ---------------------------------------------------------------------------
+# FLOPs / MFU
+# ---------------------------------------------------------------------------
+
+# fp32 peak of the chip the tunnel exposes (TPU v5e: 197 TFLOP/s bf16; the
+# package forces float32 matmuls via jax_default_matmul_precision=highest,
+# which runs on the MXU at roughly 1/4 the bf16 rate).  Used only for the
+# MFU *estimate* reported next to benchmark numbers.
+PEAK_FLOPS = {
+    "tpu_v5e_bf16": 197e12,
+    "tpu_v5e_f32": 49e12,
+    "cpu": 1e11,
+}
+
+
+def step_flops(model) -> float | None:
+    """FLOPs of one time step from XLA cost analysis; falls back to an
+    analytic dense-transform estimate when the backend doesn't expose cost
+    analysis (the axon relay)."""
+    import jax
+
+    try:
+        example = jax.tree.map(
+            lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), model.state
+        )
+        lowered = jax.jit(model._make_step()).lower(example)
+        cost = lowered.compile().cost_analysis()
+        if cost and cost.get("flops"):
+            return float(cost["flops"])
+    except Exception:
+        pass
+    return _analytic_step_flops(model)
+
+
+def _analytic_step_flops(model) -> float:
+    """GEMM-count estimate for the dense-transform TPU path of one Navier2D
+    step.  Per 2-D dense transform: 2 GEMMs = 2 * 2*n^3 flops at n x n.
+    Counted per step (navier.py _make_step): 2 velocity backwards, 6
+    convection gradient synth + 3 forwards, 3 implicit ADI solves (matvec +
+    2 dense 1-D solves each ~ 3 GEMMs), Poisson fast-diag (4 GEMMs), plus
+    elementwise O(n^2) terms (ignored)."""
+    nx, ny = model.nx, model.ny
+    n = 0.5 * (nx + ny)
+    gemms = (
+        2 * 2  # velocity backwards
+        + 6 * 2  # conv gradient backward_orthos
+        + 3 * 2  # conv forwards
+        + 3 * 3  # ADI solves
+        + 4  # fast-diag Poisson
+    )
+    return gemms * 2.0 * n**3
+
+
+def mfu_estimate(model, steps_per_sec: float) -> dict:
+    """Model-flops-utilization estimate: step FLOPs x rate / peak."""
+    import jax
+
+    flops = step_flops(model)
+    platform = jax.devices()[0].platform
+    if platform in ("tpu", "axon"):
+        key = "tpu_v5e_f32"
+    else:
+        key = "cpu"
+    peak = PEAK_FLOPS[key]
+    return {
+        "flops_per_step": flops,
+        "achieved_flops": flops * steps_per_sec,
+        "peak_flops_assumed": peak,
+        "peak_key": key,
+        "mfu": flops * steps_per_sec / peak,
+    }

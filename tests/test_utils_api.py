@@ -1,0 +1,75 @@
+"""Config dataclass, diagnostics map, profiling API, BC helper coverage."""
+
+import numpy as np
+
+from rustpde_mpi_tpu import Navier2D
+from rustpde_mpi_tpu.config import NavierConfig
+from rustpde_mpi_tpu.models.boundary_conditions import (
+    bc_zero_values,
+    transfer_function,
+)
+from rustpde_mpi_tpu.utils.profiling import (
+    StepTimer,
+    benchmark_steps,
+    mfu_estimate,
+    step_flops,
+)
+
+
+def _tiny_model():
+    return Navier2D.from_config(NavierConfig(nx=17, ny=17, ra=1e4, dt=0.01))
+
+
+def test_from_config_matches_ctor():
+    cfg = NavierConfig(nx=17, ny=17, ra=1e4, dt=0.01, write_intervall=2.0)
+    m = Navier2D.from_config(cfg)
+    assert (m.nx, m.ny) == (17, 17)
+    assert m.params["ra"] == 1e4
+    assert m.write_intervall == 2.0
+    m.update()
+    assert np.isfinite(m.get_observables()[0])
+
+
+def test_diagnostics_map_filled_by_callback(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    m = _tiny_model()
+    m.update_n(5)
+    m.callback()
+    m.update_n(5)
+    m.callback()
+    assert len(m.diagnostics["time"]) == 2
+    assert len(m.diagnostics["nu"]) == 2
+    assert m.diagnostics["time"][1] > m.diagnostics["time"][0]
+
+
+def test_benchmark_steps_and_mfu():
+    m = _tiny_model()
+    res = benchmark_steps(m, steps=4, warmup=2)
+    assert res["steps_per_sec"] > 0
+    assert res["ms_per_step"] > 0
+    flops = step_flops(m)
+    assert flops and flops > 1e5
+    mfu = mfu_estimate(m, res["steps_per_sec"])
+    assert 0 < mfu["mfu"] < 1.5  # sane fraction of assumed peak
+
+
+def test_step_timer():
+    t = StepTimer()
+    t.tick(10)
+    t.tick(10)
+    s = t.summary()
+    assert s["chunks"] == 2 and s["steps"] == 20
+    assert s["steps_per_sec_min"] <= s["steps_per_sec_max"]
+
+
+def test_transfer_function_limits():
+    """Smooth three-level transfer (boundary_conditions.rs:262-274): hits
+    v_l at the left edge, v_m in the middle, v_r at the right edge."""
+    x = np.linspace(-1, 1, 201)
+    v = transfer_function(x, 0.5, 0.0, -0.5, k=50.0)
+    assert abs(v[0] - 0.5) < 1e-6
+    assert abs(v[100]) < 1e-6
+    assert abs(v[-1] + 0.5) < 1e-6
+    mask = bc_zero_values(x, x, k=50.0)
+    assert mask.shape == (201, 201)
+    assert abs(mask[0, 0] - 0.5) < 1e-6  # bottom plate value
