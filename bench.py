@@ -153,11 +153,17 @@ def main() -> int:
     # primary metric: rbc1025 when selected, else the first config that
     # reports a rate (a subset run must not report failure just because the
     # primary config was excluded)
+    unit = "steps/s"
     primary_name = "rbc1025" if "rbc1025" in results else next(
         (k for k, v in results.items() if "steps_per_sec" in v), None
     )
+    if primary_name is None:
+        primary_name = next(
+            (k for k, v in results.items() if "solves_per_sec" in v), None
+        )
+        unit = "solves/s"
     primary = results.get(primary_name, {})
-    value = primary.get("steps_per_sec", 0.0)
+    value = primary.get("steps_per_sec", primary.get("solves_per_sec", 0.0))
     # the CPU stand-in baseline is measured at the 1025^2 config only
     vs = (
         value / CPU_BASELINE_STEPS_PER_SEC if primary_name == "rbc1025" else 0.0
@@ -168,30 +174,47 @@ def main() -> int:
         "rbc1025": "2D RBC confined 1025x1025 Ra=1e9",
         "rbc2049": "2D RBC confined 2049x2049 Ra=1e9",
         "rbc129": "2D RBC confined 129x129 Ra=1e7",
-        "rbc129_f64": "2D RBC confined 129x129 Ra=1e7 (f64)",
+        "rbc129_f64": "2D RBC confined 129x129 Ra=1e7",
         "periodic": "2D RBC periodic 128x65 Ra=1e6",
+        "poisson1025": "Poisson standalone 1025x1025",
         "sh2048": "Swift-Hohenberg 2048x2048",
     }
+    # precision tag of the run the metric actually reports (the f64 config
+    # runs in its own X64=1 subprocess regardless of this process's env)
+    x64 = os.environ.get("RUSTPDE_X64") == "1" or (
+        primary_name or ""
+    ).endswith("_f64")
+
+    def denan(v):
+        if isinstance(v, float) and v != v:
+            return None  # NaN is not valid strict JSON
+        return v
+
     payload = {
         "metric": (
-            f"timesteps/sec, {metric_names.get(primary_name, primary_name)} "
-            f"({'f64' if os.environ.get('RUSTPDE_X64') == '1' else 'f32'}, {platform})"
+            f"{'timesteps' if unit == 'steps/s' else 'solves'}/sec, "
+            f"{metric_names.get(primary_name, primary_name)} "
+            f"({'f64' if x64 else 'f32'}, {platform})"
         ),
         "value": round(value, 3),
-        "unit": "steps/s",
+        "unit": unit,
         "vs_baseline": round(vs, 2),
         "mfu": round(mfu, 4) if mfu is not None else None,
         "configs": {
             k: {
-                kk: (round(vv, 4) if isinstance(vv, float) else vv)
+                kk: denan(round(vv, 4) if isinstance(vv, float) else vv)
                 for kk, vv in v.items()
                 if kk != "mfu"
             }
             for k, v in results.items()
         },
     }
+    sanitized = {
+        k: {kk: denan(vv) for kk, vv in v.items()} if isinstance(v, dict) else v
+        for k, v in results.items()
+    }
     with open("BENCH_FULL.json", "w") as f:
-        json.dump({"platform": platform, "results": results}, f, indent=1, default=str)
+        json.dump({"platform": platform, "results": sanitized}, f, indent=1, default=str)
     print(json.dumps(payload))
     return 0 if ok and value > 0 else 1
 

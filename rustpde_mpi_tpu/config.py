@@ -26,8 +26,12 @@ if X64:
 
 # Spectral transforms/solves are precision-critical: TPU f32 matmuls default
 # to bf16 MXU passes (~1e-2 relative error), which destroys spectral accuracy.
-# "highest" keeps true f32 (or f64 under x64) accumulation.
-jax.config.update("jax_default_matmul_precision", "highest")
+# "highest" (default) keeps true f32 (or f64 under x64) accumulation via
+# 6-pass bf16; RUSTPDE_MATMUL_PRECISION=high selects the 3-pass variant —
+# ~1.6x faster steps on the MXU-bound path, measured Nu drift at the 129^2
+# parity config within the f32 noise floor (see BASELINE.md).
+MATMUL_PRECISION = os.environ.get("RUSTPDE_MATMUL_PRECISION", "highest")
+jax.config.update("jax_default_matmul_precision", MATMUL_PRECISION)
 
 
 def real_dtype():

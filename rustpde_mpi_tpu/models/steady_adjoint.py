@@ -113,9 +113,13 @@ class Navier2DAdjoint(Integrate):
 
     @classmethod
     def from_config(cls, cfg, mesh=None) -> "Navier2DAdjoint":
-        """Construct from a :class:`~rustpde_mpi_tpu.config.NavierConfig`."""
+        """Construct from a :class:`~rustpde_mpi_tpu.config.NavierConfig`
+        (same field handling as Navier2D.from_config)."""
         model = cls(*cfg.ctor_args(), periodic=cfg.periodic, mesh=mesh)
+        if cfg.init_random_amp:
+            model.init_random(cfg.init_random_amp)
         model.write_intervall = cfg.write_intervall
+        model.navier.params.update(cfg.params)
         return model
 
     # -- the adjoint iteration ------------------------------------------------

@@ -1,5 +1,17 @@
-"""Distributed execution layer: device mesh + pencil sharding."""
+"""Distributed execution layer: device mesh + pencil sharding.
 
+Two surfaces: the GSPMD constraint layer (``mesh``, what the models use —
+XLA places the collectives) and the explicit shard_map/all_to_all layer
+(``decomp``, the MPI-parity Decomp2d/collectives API for user code)."""
+
+from .decomp import (  # noqa: F401
+    Decomp2d,
+    Pencil,
+    all_gather_sum,
+    broadcast_scalar,
+    gather_root,
+    scatter_root,
+)
 from .mesh import (  # noqa: F401
     AXIS,
     PHYS,

@@ -1,0 +1,216 @@
+"""Explicit pencil decomposition + collectives (the MPI-parity surface).
+
+The models shard through GSPMD constraints (parallel/mesh.py) and never call
+these directly — XLA places the all-to-alls.  This module provides the
+*explicit* counterpart of the reference's distributed API for user code and
+custom kernels: funspace's ``Decomp2d`` bookkeeping with its
+``transpose_x_to_y``/``transpose_y_to_x`` repartitions as
+``shard_map`` + ``jax.lax.all_to_all`` over the ICI mesh, and the collectives
+the reference re-exports (``all_gather_sum``, ``broadcast_scalar``,
+gather/scatter to root) — SURVEY.md S2.2 (/root/reference/src/mpi/mod.rs:2-12,
+src/field_mpi.rs:455-477).
+
+Pencil convention (reference field_mpi.rs:71-88):
+
+* **y-pencil**: axis 0 (x) distributed, axis 1 contiguous — physical data.
+* **x-pencil**: axis 1 (y) distributed, axis 0 contiguous — spectral data.
+
+The explicit transposes require the distributed extent to divide the mesh
+size (all_to_all exchanges equal tiles); the GSPMD constraint path in the
+models handles arbitrary (odd) extents via padding and remains the execution
+path for the physics.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from .mesh import AXIS, PHYS, SPEC, make_mesh  # noqa: F401  (re-exported)
+
+try:  # jax>=0.4.35
+    from jax import shard_map
+
+    def _smap(f, mesh, in_specs, out_specs):
+        return shard_map(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs)
+
+except ImportError:  # pragma: no cover - older jax
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    def _smap(f, mesh, in_specs, out_specs):
+        return _shard_map(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs)
+
+
+@dataclass(frozen=True)
+class Pencil:
+    """One rank's slab of one pencil orientation (reference ``Decomp2d``
+    pencils expose st/en/sz, src/field_mpi.rs:128-135)."""
+
+    st: tuple[int, int]  # global start index per axis (inclusive)
+    en: tuple[int, int]  # global end index per axis (inclusive)
+    sz: tuple[int, int]  # local shape
+    dist_axis: int  # which axis is distributed
+
+    @property
+    def axis_contig(self) -> int:
+        """The undivided axis (field_mpi/average.rs:50)."""
+        return 1 - self.dist_axis
+
+
+def _split(n: int, nprocs: int, rank: int) -> tuple[int, int]:
+    """Balanced contiguous split: first (n % nprocs) ranks get one extra."""
+    base, extra = divmod(n, nprocs)
+    st = rank * base + min(rank, extra)
+    sz = base + (1 if rank < extra else 0)
+    return st, sz
+
+
+class Decomp2d:
+    """Pencil bookkeeping + explicit repartitions over a 1-D device mesh.
+
+    ``x_pencil(rank)`` / ``y_pencil(rank)`` give each rank's slab exactly as
+    the reference's decomp object does; ``transpose_x_to_y`` /
+    ``transpose_y_to_x`` are the all-to-all repartitions (jittable,
+    differentiable, runnable inside other shard_mapped code via the
+    ``*_local`` variants).
+    """
+
+    def __init__(self, global_shape: tuple[int, int], mesh: Mesh | None = None):
+        self.mesh = mesh if mesh is not None else make_mesh()
+        self.nprocs = self.mesh.shape[AXIS]
+        self.global_shape = tuple(global_shape)
+
+    # -- bookkeeping ---------------------------------------------------------
+
+    def _pencil(self, rank: int, dist_axis: int) -> Pencil:
+        n0, n1 = self.global_shape
+        if dist_axis == 0:
+            st0, sz0 = _split(n0, self.nprocs, rank)
+            return Pencil((st0, 0), (st0 + sz0 - 1, n1 - 1), (sz0, n1), 0)
+        st1, sz1 = _split(n1, self.nprocs, rank)
+        return Pencil((0, st1), (n0 - 1, st1 + sz1 - 1), (n0, sz1), 1)
+
+    def y_pencil(self, rank: int) -> Pencil:
+        """Axis 0 distributed (physical-data layout)."""
+        return self._pencil(rank, 0)
+
+    def x_pencil(self, rank: int) -> Pencil:
+        """Axis 1 distributed (spectral-data layout)."""
+        return self._pencil(rank, 1)
+
+    # -- explicit repartitions ----------------------------------------------
+
+    def _check_divisible(self, axis: int) -> None:
+        n = self.global_shape[axis]
+        if n % self.nprocs:
+            raise ValueError(
+                f"explicit transpose needs axis {axis} extent {n} divisible "
+                f"by {self.nprocs} ranks (the GSPMD constraint path in "
+                "parallel/mesh.py handles uneven extents)"
+            )
+
+    @staticmethod
+    def transpose_x_to_y_local(block):
+        """Inside-shard_map body: x-pencil block (n0, n1/P) -> y-pencil
+        block (n0/P, n1) (funspace transpose_x_to_y)."""
+        return jax.lax.all_to_all(block, AXIS, split_axis=0, concat_axis=1, tiled=True)
+
+    @staticmethod
+    def transpose_y_to_x_local(block):
+        """Inside-shard_map body: y-pencil block (n0/P, n1) -> x-pencil
+        block (n0, n1/P)."""
+        return jax.lax.all_to_all(block, AXIS, split_axis=1, concat_axis=0, tiled=True)
+
+    def transpose_x_to_y(self, arr):
+        """Global-view repartition: axis-1-sharded -> axis-0-sharded."""
+        self._check_divisible(0)
+        self._check_divisible(1)
+        fn = _smap(
+            self.transpose_x_to_y_local,
+            self.mesh,
+            in_specs=PartitionSpec(*SPEC),
+            out_specs=PartitionSpec(*PHYS),
+        )
+        return fn(arr)
+
+    def transpose_y_to_x(self, arr):
+        self._check_divisible(0)
+        self._check_divisible(1)
+        fn = _smap(
+            self.transpose_y_to_x_local,
+            self.mesh,
+            in_specs=PartitionSpec(*PHYS),
+            out_specs=PartitionSpec(*SPEC),
+        )
+        return fn(arr)
+
+    # -- placement helpers ---------------------------------------------------
+
+    def place_y_pencil(self, arr):
+        return jax.device_put(
+            jnp.asarray(arr), NamedSharding(self.mesh, PartitionSpec(*PHYS))
+        )
+
+    def place_x_pencil(self, arr):
+        return jax.device_put(
+            jnp.asarray(arr), NamedSharding(self.mesh, PartitionSpec(*SPEC))
+        )
+
+
+# ---------------------------------------------------------------------------
+# collectives (reference src/mpi/mod.rs re-exports)
+# ---------------------------------------------------------------------------
+
+
+def all_gather_sum(arr, mesh: Mesh | None = None, spec=PHYS):
+    """Sum a sharded array's per-rank contributions so every rank holds the
+    global sum — the reference's ``all_gather_sum``
+    (/root/reference/src/navier_stokes_mpi/functions.rs:137-139).  ``arr`` is
+    the global view sharded by ``spec``; the result is fully replicated."""
+    mesh = mesh if mesh is not None else make_mesh()
+
+    def body(block):
+        return jax.lax.psum(jnp.sum(block), AXIS)
+
+    fn = _smap(
+        body, mesh, in_specs=PartitionSpec(*spec), out_specs=PartitionSpec()
+    )
+    return fn(arr)
+
+
+def broadcast_scalar(value, mesh: Mesh | None = None):
+    """Root rank's value to all ranks (reference ``broadcast_scalar``; under
+    the single-controller model every process already holds host scalars, so
+    this is the in-mesh form: rank 0's lane wins)."""
+    mesh = mesh if mesh is not None else make_mesh()
+    nprocs = mesh.shape[AXIS]
+
+    def body(vals):  # vals: (1,) per rank
+        mine = jnp.where(jax.lax.axis_index(AXIS) == 0, vals[0], 0.0)
+        return jnp.full((1,), jax.lax.psum(mine, AXIS))
+
+    per_rank = jnp.asarray(value, dtype=jnp.result_type(value, 0.0)).reshape(())
+    stacked = jnp.broadcast_to(per_rank, (nprocs,))
+    fn = _smap(body, mesh, in_specs=PartitionSpec(AXIS), out_specs=PartitionSpec(AXIS))
+    return fn(stacked)[0]
+
+
+def gather_root(arr) -> np.ndarray:
+    """Full global array on the host — the reference's gather-to-root IO path
+    (/root/reference/src/field_mpi/io.rs:45-70).  Under JAX's
+    single-controller model this is one device-to-host fetch; across real
+    multi-host meshes use jax.experimental.multihost_utils instead."""
+    return np.asarray(arr)
+
+
+def scatter_root(values, decomp: Decomp2d, pencil: str = "y"):
+    """Host array -> pencil-sharded device array (reference scatter,
+    field_mpi.rs:359-453)."""
+    if pencil == "y":
+        return decomp.place_y_pencil(values)
+    return decomp.place_x_pencil(values)

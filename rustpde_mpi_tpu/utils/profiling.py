@@ -27,7 +27,7 @@ def _sync(model) -> None:
     if hasattr(model, "state"):
         np.asarray(model.state.temp[:1, :1])
     else:  # models without .state (e.g. Swift-Hohenberg) expose .theta
-        np.asarray(model.theta[..., :1, :1])
+        np.asarray(model.theta.ravel()[:1])
 
 
 def benchmark_steps(model, steps: int, warmup: int | None = None) -> dict:

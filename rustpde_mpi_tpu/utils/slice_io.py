@@ -1,0 +1,76 @@
+"""Hyperslab (slice) HDF5 IO.
+
+Rebuild of the reference's ``io::read_write_slice_hdf5``
+(/root/reference/src/io/read_write_slice_hdf5.rs:18-60): create-or-open a
+dataset of a known global shape and read/write one rank's rectangular slab.
+The reference uses this for rank-sequential parallel IO
+(field_mpi/io_mpi_sequ.rs); here the same surface serves pencil-slab IO
+under the single-controller model — ``write_pencils`` streams a sharded
+array to disk slab-by-slab without materializing the global array twice.
+Complex data is stored as ``{name}_re``/``{name}_im`` pairs like the rest of
+the checkpoint layer (/root/reference/src/io/read_write_hdf5.rs:171-188).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def _h5():
+    import h5py
+
+    return h5py
+
+
+def write_slice(filename, dsname: str, data, offset, global_shape) -> None:
+    """Write ``data`` into the hyperslab at ``offset`` of dataset ``dsname``
+    (created with ``global_shape`` on first touch; file append-or-create)."""
+    data = np.asarray(data)
+    if np.iscomplexobj(data):
+        write_slice(filename, dsname + "_re", data.real, offset, global_shape)
+        write_slice(filename, dsname + "_im", data.imag, offset, global_shape)
+        return
+    sel = tuple(slice(o, o + s) for o, s in zip(offset, data.shape))
+    with _h5().File(filename, "a") as f:
+        if dsname in f:
+            ds = f[dsname]
+            if tuple(ds.shape) != tuple(global_shape):
+                raise ValueError(
+                    f"dataset {dsname} exists with shape {ds.shape}, "
+                    f"expected {tuple(global_shape)}"
+                )
+        else:
+            ds = f.create_dataset(dsname, shape=tuple(global_shape), dtype=data.dtype)
+        ds[sel] = data
+
+
+def read_slice(filename, dsname: str, offset, shape, is_complex: bool = False):
+    """Read the hyperslab at ``offset`` of extent ``shape``."""
+    if is_complex:
+        re = read_slice(filename, dsname + "_re", offset, shape)
+        im = read_slice(filename, dsname + "_im", offset, shape)
+        return re + 1j * im
+    sel = tuple(slice(o, o + s) for o, s in zip(offset, shape))
+    with _h5().File(filename, "r") as f:
+        return np.asarray(f[dsname][sel])
+
+
+def write_pencils(filename, dsname: str, arr, decomp, pencil: str = "y") -> None:
+    """Stream a pencil-sharded global-view array to disk one rank-slab at a
+    time (the reference's rank-serialized writer, io_mpi_sequ.rs) — each
+    slab is fetched and written independently, so peak host memory is one
+    slab, not the global array."""
+    get = decomp.y_pencil if pencil == "y" else decomp.x_pencil
+    global_shape = decomp.global_shape
+    for rank in range(decomp.nprocs):
+        p = get(rank)
+        sel = tuple(slice(st, st + s) for st, s in zip(p.st, p.sz))
+        block = np.asarray(arr[sel])  # fetches only this slab's shards
+        write_slice(filename, dsname, block, p.st, global_shape)
+
+
+def read_pencil(filename, dsname: str, decomp, rank: int, pencil: str = "y",
+                is_complex: bool = False):
+    """One rank's slab of a dataset."""
+    p = (decomp.y_pencil if pencil == "y" else decomp.x_pencil)(rank)
+    return read_slice(filename, dsname, p.st, p.sz, is_complex=is_complex)
