@@ -11,9 +11,10 @@ Usage:  python examples/navier_lnse_opt_reversals.py [--quick]
   --quick shrinks the grid/horizons so the whole campaign runs in ~a minute.
 """
 
+import os
 import sys
 
-sys.path.insert(0, ".")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import numpy as np  # noqa: E402
 

@@ -10,9 +10,10 @@ this framework's exact discrete gradient via JAX autodiff (matches FD to
 Usage:  python examples/navier_lnse_test_gradient.py [--quick]
 """
 
+import os
 import sys
 
-sys.path.insert(0, ".")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import numpy as np  # noqa: E402
 

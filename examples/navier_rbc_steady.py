@@ -8,10 +8,11 @@ steady state converges (mean residual < 1e-7).
 Usage:  python examples/navier_rbc_steady.py [--quick]
 """
 
+import os
 import sys
 import time
 
-sys.path.insert(0, ".")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from rustpde_mpi_tpu import Navier2DAdjoint, integrate  # noqa: E402
 

@@ -1,0 +1,144 @@
+"""Multi-host (multi-slice / DCN) entry points.
+
+The reference scales across nodes with MPI ranks (rsmpi over system MPI,
+/root/reference/src/mpi/mod.rs); the JAX equivalent is one *controller per
+host* with a global device mesh — intra-slice traffic rides ICI, inter-slice
+DCN, and the same GSPMD/pencil code (parallel/mesh.py, parallel/decomp.py)
+runs unchanged on the larger mesh.  This module is the thin glue:
+
+* :func:`initialize_distributed` — ``jax.distributed.initialize`` with the
+  standard env-var conventions (the MPI_Init analog).
+* :func:`global_pencil_mesh` — the 1-D pencil mesh over every device of
+  every host.
+* :func:`host_local_array` / :func:`global_array` — host-slab <-> global
+  array conversion for IO (the gather/scatter-to-root analog across hosts).
+* :func:`sync_hosts` — barrier.
+
+Single-host processes (including this container's one-chip tunnel and the
+virtual CPU mesh) can call everything here unchanged: initialization is a
+no-op fallback and the conversions degenerate to identity, which is what the
+single-controller tests exercise.  True multi-host execution needs one
+process per host started with the same script (the driver/launcher's job),
+exactly as the reference needs ``mpirun``.
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+import numpy as np
+
+from .mesh import AXIS, make_mesh
+
+def _cluster_env_configured() -> bool:
+    """True when the environment really describes a multi-host cluster — an
+    initialization failure must then propagate, not silently degrade to N
+    independent single-host runs.  A coordinator address is definitive; a
+    worker-hostname list counts only when it names more than one host (TPU
+    plugins set TPU_WORKER_HOSTNAMES=localhost even on one chip)."""
+    if os.environ.get("JAX_COORDINATOR_ADDRESS") or os.environ.get(
+        "MEGASCALE_COORDINATOR_ADDRESS"
+    ):
+        return True
+    return "," in os.environ.get("TPU_WORKER_HOSTNAMES", "")
+
+
+def initialize_distributed(
+    coordinator_address: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+) -> bool:
+    """Initialize the multi-process runtime (MPI_Init analog).
+
+    Arguments default to the standard env vars (JAX_COORDINATOR_ADDRESS,
+    JAX_NUM_PROCESSES, JAX_PROCESS_ID) or cloud auto-detection — None values
+    are passed through to ``jax.distributed.initialize`` so its own
+    auto-detection stays in charge.  Returns True if a multi-process runtime
+    was initialized, False when running single-process (no cluster
+    configured) — callers need no branches, jax.devices() is global either
+    way."""
+    if num_processes is not None and (
+        coordinator_address is None
+        and os.environ.get("JAX_COORDINATOR_ADDRESS") is None
+    ):
+        raise ValueError(
+            "num_processes given but no coordinator address (argument or "
+            "JAX_COORDINATOR_ADDRESS)"
+        )
+    explicit = any(
+        v is not None for v in (coordinator_address, num_processes, process_id)
+    )
+    if not explicit and not _cluster_env_configured():
+        # plain single-host launch: probe auto-detection, degrade quietly
+        try:
+            jax.distributed.initialize()
+        except Exception:
+            return False
+        return jax.process_count() > 1
+    # a cluster is configured (explicitly or via env) — failures are real
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    return jax.process_count() > 1
+
+
+def global_pencil_mesh() -> jax.sharding.Mesh:
+    """1-D pencil mesh over all devices of all hosts — pass as ``mesh=`` to
+    any model; pencil axes then span ICI within a slice and DCN across."""
+    return make_mesh()
+
+
+def process_index() -> int:
+    """This host's rank (the reference's ``nrank``)."""
+    return jax.process_index()
+
+
+def is_root() -> bool:
+    """Rank-0 check for root-guarded IO/logging
+    (/root/reference/src/mpi/mod.rs:57-74)."""
+    return jax.process_index() == 0
+
+
+def global_array(host_local: np.ndarray, sharding) -> jax.Array:
+    """Assemble per-host slabs into one global sharded array
+    (scatter analog).  Identity-like on a single host."""
+    if jax.process_count() == 1:
+        return jax.device_put(host_local, sharding)
+    from jax.experimental import multihost_utils
+
+    return multihost_utils.host_local_array_to_global_array(
+        host_local, sharding.mesh, sharding.spec
+    )
+
+
+def host_local_array(arr: jax.Array) -> np.ndarray:
+    """This host's slab of a global array (gather analog for per-host IO);
+    the full array on a single host.  Multi-host conversion needs the mesh,
+    so the array must carry a NamedSharding (anything placed through
+    global_array / the pencil mesh does)."""
+    if jax.process_count() == 1:
+        return np.asarray(arr)
+    from jax.experimental import multihost_utils
+
+    if not isinstance(arr.sharding, jax.sharding.NamedSharding):
+        raise TypeError(
+            "host_local_array on a multi-host run needs a NamedSharding-"
+            f"placed array, got {type(arr.sharding).__name__}; place it via "
+            "global_array(...) or a mesh-sharded computation first"
+        )
+    return multihost_utils.global_array_to_host_local_array(
+        arr, arr.sharding.mesh, arr.sharding.spec
+    )
+
+
+def sync_hosts(tag: str = "barrier") -> None:
+    """Cross-host barrier (the reference's MPI barrier,
+    src/field_mpi/io_mpi_sequ.rs:46); no-op single-host."""
+    if jax.process_count() == 1:
+        return
+    from jax.experimental import multihost_utils
+
+    multihost_utils.sync_global_devices(tag)

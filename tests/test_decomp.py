@@ -126,3 +126,20 @@ def test_slice_io_roundtrip(tmp_path, mesh):
     # shape-mismatch guard
     with pytest.raises(ValueError, match="exists with shape"):
         write_slice(fname, "v", a, (0, 0), (8, 24))
+
+
+def test_multihost_single_process_degenerate(mesh):
+    """The multi-host glue degenerates to identity single-process, so the
+    same program text runs on one chip, the virtual mesh, and a pod."""
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    from rustpde_mpi_tpu.parallel import multihost as mh
+
+    assert mh.initialize_distributed() is False  # no coordinator configured
+    assert mh.process_index() == 0 and mh.is_root()
+    m = mh.global_pencil_mesh()
+    assert m.shape[AXIS] == len(jax.devices())
+    a = np.arange(64.0).reshape(8, 8)
+    sharded = mh.global_array(a, NamedSharding(m, PartitionSpec(AXIS, None)))
+    np.testing.assert_array_equal(mh.host_local_array(sharded), a)
+    mh.sync_hosts()  # no-op
