@@ -41,7 +41,17 @@ def _cluster_env_configured() -> bool:
         "MEGASCALE_COORDINATOR_ADDRESS"
     ):
         return True
-    return "," in os.environ.get("TPU_WORKER_HOSTNAMES", "")
+    if "," in os.environ.get("TPU_WORKER_HOSTNAMES", ""):
+        return True
+    # schedulers jax.distributed auto-detects: a multi-task Slurm or Open
+    # MPI launch is a cluster even without explicit JAX env vars
+    for var in ("SLURM_NTASKS", "OMPI_COMM_WORLD_SIZE"):
+        try:
+            if int(os.environ.get(var, "1")) > 1:
+                return True
+        except ValueError:
+            pass
+    return False
 
 
 def initialize_distributed(

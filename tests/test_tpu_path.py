@@ -79,3 +79,67 @@ def test_dense_adi_matches_banded():
     x_banded = np.asarray(HholtzAdi(space, (0.1, 0.1), method="banded").solve(rhs))
     x_dense = np.asarray(HholtzAdi(space, (0.1, 0.1), method="dense").solve(rhs))
     np.testing.assert_allclose(x_dense, x_banded, atol=1e-11)
+
+
+def test_periodic_model_tpu_split_path_matches_complex(tpu_path, monkeypatch):
+    """Horizontally-periodic model on the forced TPU path (split Re/Im
+    Fourier + matmul transforms) vs the CPU complex-FFT path."""
+
+    def build():
+        model = Navier2D(16, 17, 1e4, 1.0, 0.01, 1.0, "rbc", periodic=True)
+        model.set_velocity(0.1, 1.0, 1.0)
+        model.set_temperature(0.1, 1.0, 1.0)
+        return model
+
+    tpu_model = build()
+    assert tpu_model.temp_space.base_x.kind.is_split
+    monkeypatch.delenv("RUSTPDE_FORCE_TPU_PATH")
+    cpu_model = build()
+    assert not cpu_model.temp_space.base_x.kind.is_split
+
+    tpu_model.update_n(20)
+    cpu_model.update_n(20)
+    np.testing.assert_allclose(
+        tpu_model.get_field("temp"), cpu_model.get_field("temp"), atol=1e-9
+    )
+    for va, vb in zip(tpu_model.get_observables(), cpu_model.get_observables()):
+        assert va == pytest.approx(vb, rel=1e-8, abs=1e-10)
+
+
+def test_swift_hohenberg_tpu_matmul_path(tpu_path, monkeypatch):
+    """SH2D biperiodic space auto-selects matmul under the forced TPU path
+    and reproduces the FFT-path trajectory."""
+    from rustpde_mpi_tpu import SwiftHohenberg2D
+
+    tpu_model = SwiftHohenberg2D(16, 16, r=0.3, dt=0.02, length=6.0)
+    assert tpu_model.space.method == "matmul"
+    monkeypatch.delenv("RUSTPDE_FORCE_TPU_PATH")
+    cpu_model = SwiftHohenberg2D(16, 16, r=0.3, dt=0.02, length=6.0)
+    assert cpu_model.space.method == "fft"
+    tpu_model.update_n(50)
+    cpu_model.update_n(50)
+    np.testing.assert_allclose(
+        tpu_model.theta_physical(), cpu_model.theta_physical(), atol=1e-10
+    )
+
+
+def test_penalization_tpu_path_matches_default(tpu_path, monkeypatch):
+    """Brinkman penalization on the forced TPU path == default path."""
+    from rustpde_mpi_tpu.models.solid_masks import solid_cylinder_inner
+
+    def build():
+        model = Navier2D(17, 17, 1e4, 1.0, 0.01, 1.0, "rbc", periodic=False)
+        x, y = model.x
+        mask, value = solid_cylinder_inner(x, y, 0.0, 0.0, 0.3)
+        model.set_solid(mask, value)
+        model.set_velocity(0.1, 1.0, 1.0)
+        return model
+
+    tpu_model = build()
+    monkeypatch.delenv("RUSTPDE_FORCE_TPU_PATH")
+    cpu_model = build()
+    tpu_model.update_n(20)
+    cpu_model.update_n(20)
+    np.testing.assert_allclose(
+        tpu_model.get_field("velx"), cpu_model.get_field("velx"), atol=1e-10
+    )
