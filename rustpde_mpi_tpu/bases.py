@@ -28,6 +28,7 @@ from . import config
 from .ops import chebyshev as chb
 from .ops import fourier as fou
 from .ops import transforms as tr
+from .ops.folded import FoldedMatrix
 
 
 class BaseKind(enum.Enum):
@@ -199,40 +200,49 @@ class Base:
 
     # -- device transforms --------------------------------------------------
 
+    # transform/operator matrices are wrapped in FoldedMatrix: the even/odd
+    # parity every pure-Chebyshev operator carries (the reference's stride-2
+    # structure, solver/tdma.rs:49-82) halves the GEMM flops; matrices
+    # without the structure (mixed-BC bases) automatically run the plain GEMM
+
     @cached_property
-    def _fwd_matrix(self):
+    def _fwd_matrix(self) -> FoldedMatrix:
         if self.kind.is_chebyshev:
-            return _dev(self.projection @ chb.analysis_matrix(self.n))
+            return FoldedMatrix(self.projection @ chb.analysis_matrix(self.n), _dev)
         raise ValueError("matmul transform only for Chebyshev bases")
 
     @cached_property
-    def _bwd_matrix(self):
+    def _bwd_matrix(self) -> FoldedMatrix:
         if self.kind.is_chebyshev:
-            return _dev(chb.synthesis_matrix(self.n) @ self.stencil)
+            return FoldedMatrix(chb.synthesis_matrix(self.n) @ self.stencil, _dev)
         raise ValueError("matmul transform only for Chebyshev bases")
 
     @cached_property
-    def _stencil_dev(self):
-        return _dev(self.stencil)
+    def _stencil_dev(self) -> FoldedMatrix:
+        return FoldedMatrix(self.stencil, _dev)
 
     @cached_property
-    def _proj_dev(self):
-        return _dev(self.projection)
+    def _proj_dev(self) -> FoldedMatrix:
+        return FoldedMatrix(self.projection, _dev)
 
     @cached_property
-    def _synthesis_dev(self):
-        return _dev(chb.synthesis_matrix(self.n))
+    def _synthesis_dev(self) -> FoldedMatrix:
+        return FoldedMatrix(chb.synthesis_matrix(self.n), _dev)
 
     def _gradient_dev(self, order: int):
+        """Chebyshev: FoldedMatrix; Fourier: cached device diagonal."""
         if order not in self._grad_dev_cache:
-            self._grad_dev_cache[order] = _dev(self.gradient_matrix(order))
+            mat = self.gradient_matrix(order)
+            self._grad_dev_cache[order] = (
+                FoldedMatrix(mat, _dev) if self.kind.is_chebyshev else _dev(mat)
+            )
         return self._grad_dev_cache[order]
 
     def forward(self, v, axis: int, method: str = "fft"):
         """Physical -> (composite) spectral along ``axis``."""
         if self.kind.is_chebyshev:
             if method == "matmul":
-                return tr.apply_matrix(self._fwd_matrix, v, axis)
+                return self._fwd_matrix.apply(v, axis)
             c = tr.cheb_forward_fft(v, axis)
             return self.from_ortho(c, axis)
         if self.kind == BaseKind.FOURIER_R2C:
@@ -243,7 +253,7 @@ class Base:
         """(Composite) spectral -> physical along ``axis``."""
         if self.kind.is_chebyshev:
             if method == "matmul":
-                return tr.apply_matrix(self._bwd_matrix, vhat, axis)
+                return self._bwd_matrix.apply(vhat, axis)
             return tr.cheb_backward_fft(self.to_ortho(vhat, axis), axis)
         if self.kind == BaseKind.FOURIER_R2C:
             return tr.fourier_r2c_backward_fft(vhat, axis, self.n)
@@ -254,7 +264,7 @@ class Base:
         ``axis`` (no composite cast — gradients already live in ortho space)."""
         if self.kind.is_chebyshev:
             if method == "matmul":
-                return tr.apply_matrix(self._synthesis_dev, c, axis)
+                return self._synthesis_dev.apply(c, axis)
             return tr.cheb_backward_fft(c, axis)
         if self.kind == BaseKind.FOURIER_R2C:
             return tr.fourier_r2c_backward_fft(c, axis, self.n)
@@ -263,21 +273,20 @@ class Base:
     def to_ortho(self, vhat, axis: int):
         if self.kind in (BaseKind.CHEBYSHEV, BaseKind.FOURIER_R2C, BaseKind.FOURIER_C2C):
             return vhat
-        return tr.apply_matrix(self._stencil_dev, vhat, axis)
+        return self._stencil_dev.apply(vhat, axis)
 
     def from_ortho(self, c, axis: int):
         if self.kind in (BaseKind.CHEBYSHEV, BaseKind.FOURIER_R2C, BaseKind.FOURIER_C2C):
             return c
-        return tr.apply_matrix(self._proj_dev, c, axis)
+        return self._proj_dev.apply(c, axis)
 
     def gradient(self, vhat, order: int, axis: int):
         """Composite spectral -> ortho-space derivative coefficients."""
         if order == 0:
             return self.to_ortho(vhat, axis)
-        g = self._gradient_dev(order)
         if self.kind.is_chebyshev:
-            return tr.apply_matrix(g, vhat, axis)
-        return tr.apply_diag(g, vhat, axis)
+            return self._gradient_dev(order).apply(vhat, axis)
+        return tr.apply_diag(self._gradient_dev(order), vhat, axis)
 
     def dealias_cut(self) -> np.ndarray:
         """1-D 2/3-rule mask over this base's spectral rows

@@ -90,6 +90,30 @@ class Field2:
         periodic = tuple(b.is_periodic for b in self.space.bases)
         return average(self.v, self.x, self.dx, periodic=periodic)
 
+    # -- per-field HDF5 IO (reference ReadWrite trait,
+    #    /root/reference/src/io/traits.rs:10-25, src/field/io.rs) -----------
+
+    def write(self, filename: str, group: str) -> None:
+        """Write this field as a ``{group}/{x,dx,y,dy,v,vhat}`` HDF5 group
+        (create-or-append file semantics, like the reference)."""
+        import h5py
+
+        from .utils import checkpoint
+
+        with h5py.File(filename, "a") as h5:
+            checkpoint.write_field(h5, group, self.space, self.vhat, self.x, self.dx)
+
+    def read(self, filename: str, group: str) -> None:
+        """Restore spectral coefficients from a snapshot group (spectral
+        interpolation on resolution mismatch, src/field/io.rs:74-83)."""
+        import h5py
+
+        from .utils import checkpoint
+
+        with h5py.File(filename, "r") as h5:
+            vhat = checkpoint.read_field_vhat(h5, group, self.space)
+        self.vhat = jnp.asarray(vhat, dtype=self.space.spectral_dtype())
+
 
 def _axis_length(x, dx, axis: int, periodic: bool) -> float:
     """Axis length for the average weight.  Deliberate fix over the reference

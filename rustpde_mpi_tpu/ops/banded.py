@@ -128,20 +128,19 @@ def _banded_solve_moved(lower, upper, p: int, q: int, b):
 
 class DenseSolver:
     """Precomputed dense inverse; solve = one GEMM (MXU path for static
-    well-conditioned systems)."""
+    well-conditioned systems).  Parity-preserving operators (every pure-
+    Chebyshev Helmholtz pencil) have checkerboard-sparse inverses, which the
+    FoldedMatrix wrapper turns into two half-size GEMMs (ops/folded.py)."""
 
     def __init__(self, dense: np.ndarray, dtype=None):
+        from .folded import FoldedMatrix
+
         dt = dtype or jnp.zeros(0).dtype
-        self.inv = jnp.asarray(np.linalg.inv(np.asarray(dense, dtype=np.float64)), dtype=dt)
+        inv = np.linalg.inv(np.asarray(dense, dtype=np.float64))
+        self._folded = FoldedMatrix(inv, lambda m: jnp.asarray(m, dtype=dt))
 
     def solve(self, b, axis: int):
-        if jnp.iscomplexobj(b):
-            inv = self.inv.astype(b.dtype)
-        else:
-            inv = self.inv
-        moved = jnp.moveaxis(b, axis, 0)
-        out = jnp.tensordot(inv, moved, axes=([1], [0]))
-        return jnp.moveaxis(out, 0, axis)
+        return self._folded.apply(b, axis)
 
 
 class DiagSolver:

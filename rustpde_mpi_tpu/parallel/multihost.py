@@ -43,9 +43,11 @@ def _cluster_env_configured() -> bool:
         return True
     if "," in os.environ.get("TPU_WORKER_HOSTNAMES", ""):
         return True
-    # schedulers jax.distributed auto-detects: a multi-task Slurm or Open
-    # MPI launch is a cluster even without explicit JAX env vars
-    for var in ("SLURM_NTASKS", "OMPI_COMM_WORLD_SIZE"):
+    # schedulers jax.distributed auto-detects: gate on *per-step* launch
+    # variables (set by srun/mpirun for this very process), not allocation-
+    # level ones — a single `python` inside an --ntasks=8 batch allocation
+    # is still a single-host run
+    for var in ("SLURM_STEP_NUM_TASKS", "OMPI_COMM_WORLD_SIZE"):
         try:
             if int(os.environ.get(var, "1")) > 1:
                 return True

@@ -36,7 +36,7 @@ import numpy as np
 from . import config
 from .bases import Base, BaseKind, Space2  # noqa: F401
 from .ops.banded import BandedSolver, DenseSolver, DiagSolver
-from .ops.transforms import apply_diag, apply_matrix
+from .ops.folded import FoldedMatrix
 
 _P, _Q = 2, 4  # lower/upper bandwidth of every preconditioned Chebyshev operator
 
@@ -62,16 +62,42 @@ def ingredients_for_hholtz(space: Space2, axis: int):
 
 
 def _sorted_real_eig(x: np.ndarray):
-    """Eigendecomposition with eigenvalues sorted descending by real part
-    (matching the reference's utils::eig ordering so the singular mode lands
-    at index 0, /root/reference/src/solver/utils.rs:88-95)."""
+    """Eigendecomposition ordered for the fast-diagonalisation GEMMs.
+
+    The pure-Chebyshev pencils are parity-preserving (checkerboard), so
+    their eigenvectors carry definite even/odd parity.  Ordering eigenpairs
+    with vector parity alternating along the eigen index — evens at even
+    positions, odds at odd, each block descending by eigenvalue — makes the
+    modal maps Q / Q^-1C^-1P themselves checkerboard, so the FoldedMatrix
+    wrapper halves those GEMMs too (ops/folded.py).  The singular mode of
+    pure-Neumann problems is the constant (even, largest-lam) vector and
+    still lands at index 0, preserving the reference's contract
+    (/root/reference/src/solver/utils.rs:88-95, poisson.rs:84-87).  Pencils
+    without parity structure (mixed-BC bases) keep the plain descending
+    sort."""
     lam, q = np.linalg.eig(x)
     if np.abs(lam.imag).max() > 1e-8 * max(np.abs(lam.real).max(), 1.0):
         raise ValueError("tensor-solver eigenvalues are significantly complex")
-    order = np.argsort(lam.real)[::-1]
-    lam = lam.real[order]
-    q = q.real[:, order] if np.iscomplexobj(q) else q[:, order]
-    return lam, q
+    lam = lam.real
+    q = q.real if np.iscomplexobj(q) else q
+    order = np.argsort(lam)[::-1]
+
+    # eigenvector parity: support only on even or only on odd rows
+    scale = np.abs(q).max(axis=0)
+    odd_part = np.abs(q[1::2]).max(axis=0)
+    even_part = np.abs(q[0::2]).max(axis=0)
+    tol = 1e-8 * scale
+    is_even = odd_part <= tol
+    is_odd = even_part <= tol
+    m = lam.size
+    n_even_target = (m + 1) // 2
+    if is_even.sum() == n_even_target and is_odd.sum() == m - n_even_target:
+        evens = [i for i in order if is_even[i]]
+        odds = [i for i in order if is_odd[i]]
+        order = np.empty(m, dtype=int)
+        order[0::2] = evens
+        order[1::2] = odds
+    return lam[order], q[:, order]
 
 
 def _axis_modal_data(space: Space2, axis: int, ci: float, sign: float):
@@ -142,8 +168,14 @@ class HholtzAdi:
             mat = mat_a - ci * mat_b
             kind = space.base_kind(axis)
             self.solvers.append(_AxisSolver(mat, kind, method))
+            # the B2 precond is checkerboard parity-foldable like every
+            # pure-Chebyshev operator (ops/folded.py) -> two half GEMMs
             self.matvec.append(
-                jnp.asarray(precond, dtype=config.real_dtype()) if precond is not None else None
+                FoldedMatrix(
+                    precond, lambda m: jnp.asarray(m, dtype=config.real_dtype())
+                )
+                if precond is not None
+                else None
             )
 
     def solve(self, rhs):
@@ -157,10 +189,10 @@ class HholtzAdi:
 
         out = constrain(rhs, SPEC)
         if self.matvec[0] is not None:
-            out = apply_matrix(self.matvec[0], out, 0)
+            out = self.matvec[0].apply(out, 0)
         out = constrain(out, PHYS)
         if self.matvec[1] is not None:
-            out = apply_matrix(self.matvec[1], out, 1)
+            out = self.matvec[1].apply(out, 1)
         out = self.solvers[1].solve(out, 1)  # axis-1 recurrence, lanes = axis 0
         out = constrain(out, SPEC)
         out = self.solvers[0].solve(out, 0)  # axis-0 recurrence, lanes = axis 1
@@ -181,8 +213,9 @@ class TensorSolver:
     def __init__(self, modal0, a1, c1, precond1, alpha: float, fix_singular=False):
         dt = config.real_dtype()
         lam, fwd0, bwd0 = modal0
-        self.fwd = jnp.asarray(fwd0, dtype=dt) if fwd0 is not None else None
-        self.bwd = jnp.asarray(bwd0, dtype=dt) if bwd0 is not None else None
+        to_dev = lambda m: jnp.asarray(m, dtype=dt)  # noqa: E731
+        self.fwd = FoldedMatrix(fwd0, to_dev) if fwd0 is not None else None
+        self.bwd = FoldedMatrix(bwd0, to_dev) if bwd0 is not None else None
         if fix_singular and abs(lam[0]) < 1e-10:
             # pure-Neumann problems: nudge the zero mode so the banded
             # factorization exists (/root/reference/src/solver/poisson.rs:84-87)
@@ -191,7 +224,9 @@ class TensorSolver:
         self.lam = lam
         self.alpha = alpha
         self.matvec1 = (
-            jnp.asarray(precond1, dtype=dt) if precond1 is not None else None
+            FoldedMatrix(precond1, lambda m: jnp.asarray(m, dtype=dt))
+            if precond1 is not None
+            else None
         )
         # (A_y + (lam_i + alpha) C_y) factored for every eigenvalue lane i
         mats = a1[None, :, :] + (lam[:, None, None] + alpha) * c1[None, :, :]
@@ -206,14 +241,14 @@ class TensorSolver:
 
         out = constrain(rhs, SPEC)
         if self.matvec1 is not None:
-            out = apply_matrix(self.matvec1, constrain(out, PHYS), 1)
+            out = self.matvec1.apply(constrain(out, PHYS), 1)
         out = constrain(out, SPEC)
         if self.fwd is not None:
-            out = apply_matrix(self.fwd, out, 0)
+            out = self.fwd.apply(out, 0)
         out = self.banded.solve(constrain(out, PHYS), 1)
         out = constrain(out, SPEC)
         if self.bwd is not None:
-            out = apply_matrix(self.bwd, out, 0)
+            out = self.bwd.apply(out, 0)
         return constrain(out, SPEC)
 
 
@@ -235,9 +270,10 @@ class FastDiag:
     def __init__(self, modal0, modal1, alpha: float, fix_singular=False):
         dt = config.real_dtype()
         lams, self.fwd, self.bwd = [], [], []
+        to_dev = lambda m: jnp.asarray(m, dtype=dt)  # noqa: E731
         for lam, fwd, bwd in (modal0, modal1):
-            self.fwd.append(jnp.asarray(fwd, dtype=dt) if fwd is not None else None)
-            self.bwd.append(jnp.asarray(bwd, dtype=dt) if bwd is not None else None)
+            self.fwd.append(FoldedMatrix(fwd, to_dev) if fwd is not None else None)
+            self.bwd.append(FoldedMatrix(bwd, to_dev) if bwd is not None else None)
             lams.append(lam)
         if fix_singular and abs(lams[0][0]) < 1e-10:
             # pure-Neumann zero mode: same nudge as the reference
@@ -254,16 +290,16 @@ class FastDiag:
 
         out = constrain(rhs, SPEC)
         if self.fwd[0] is not None:
-            out = apply_matrix(self.fwd[0], out, 0)
+            out = self.fwd[0].apply(out, 0)
         out = constrain(out, PHYS)
         if self.fwd[1] is not None:
-            out = apply_matrix(self.fwd[1], out, 1)
+            out = self.fwd[1].apply(out, 1)
         out = out / self.denom.astype(out.dtype)
         if self.bwd[1] is not None:
-            out = apply_matrix(self.bwd[1], out, 1)
+            out = self.bwd[1].apply(out, 1)
         out = constrain(out, SPEC)
         if self.bwd[0] is not None:
-            out = apply_matrix(self.bwd[0], out, 0)
+            out = self.bwd[0].apply(out, 0)
         return constrain(out, SPEC)
 
 
