@@ -213,8 +213,20 @@ def main() -> int:
         k: {kk: denan(vv) for kk, vv in v.items()} if isinstance(v, dict) else v
         for k, v in results.items()
     }
+    # merge into the existing record so a subset run updates its configs
+    # without deleting the rest of the matrix — but never mix platforms
+    # (a CPU subset run must not get attributed TPU numbers or vice versa)
+    record: dict = {"platform": platform, "results": {}}
+    try:
+        with open("BENCH_FULL.json") as f:
+            prev = json.load(f)
+        if prev.get("platform") == platform and isinstance(prev.get("results"), dict):
+            record["results"].update(prev["results"])
+    except (OSError, ValueError):
+        pass
+    record["results"].update(sanitized)
     with open("BENCH_FULL.json", "w") as f:
-        json.dump({"platform": platform, "results": sanitized}, f, indent=1, default=str)
+        json.dump(record, f, indent=1, default=str)
     print(json.dumps(payload))
     return 0 if ok and value > 0 else 1
 

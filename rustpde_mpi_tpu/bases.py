@@ -333,20 +333,22 @@ class SplitFourierBase(Base):
     # -- transforms ----------------------------------------------------------
 
     @cached_property
-    def _fwd_dev(self):
-        return _dev(fou.split_forward_matrix(self.n))
+    def _fwd_dev(self) -> FoldedMatrix:
+        # circular-reflection fold (cos rows symmetric / sin rows antisym
+        # under j -> n-j) halves the split-transform GEMM (ops/folded.py)
+        return FoldedMatrix(fou.split_forward_matrix(self.n), _dev)
 
     @cached_property
-    def _bwd_dev(self):
-        return _dev(fou.split_backward_matrix(self.n))
+    def _bwd_dev(self) -> FoldedMatrix:
+        return FoldedMatrix(fou.split_backward_matrix(self.n), _dev)
 
     def forward(self, v, axis: int, method: str = "matmul"):
         del method  # matmul is the only (and native) path
-        return tr.apply_matrix(self._fwd_dev, v, axis)
+        return self._fwd_dev.apply(v, axis)
 
     def backward(self, vhat, axis: int, method: str = "matmul"):
         del method
-        return tr.apply_matrix(self._bwd_dev, vhat, axis)
+        return self._bwd_dev.apply(vhat, axis)
 
     def backward_ortho(self, c, axis: int, method: str = "matmul"):
         return self.backward(c, axis)
@@ -774,22 +776,22 @@ class BiPeriodicSpace2:
     # -- transform matrices (host, lazily built) ----------------------------
 
     @cached_property
-    def _y_fwd(self):
-        return _dev(fou.split_forward_matrix(self.ny))  # (2my, ny)
+    def _y_fwd(self) -> FoldedMatrix:
+        return FoldedMatrix(fou.split_forward_matrix(self.ny), _dev)  # (2my, ny)
 
     @cached_property
-    def _y_bwd(self):
-        return _dev(fou.split_backward_matrix(self.ny))  # (ny, 2my)
+    def _y_bwd(self) -> FoldedMatrix:
+        return FoldedMatrix(fou.split_backward_matrix(self.ny), _dev)  # (ny, 2my)
 
     @cached_property
-    def _x_cos(self):
+    def _x_cos(self) -> FoldedMatrix:
         k = np.arange(self.nx)[:, None] * np.arange(self.nx)[None, :]
-        return _dev(np.cos(2.0 * np.pi * k / self.nx))
+        return FoldedMatrix(np.cos(2.0 * np.pi * k / self.nx), _dev)
 
     @cached_property
-    def _x_sin(self):
+    def _x_sin(self) -> FoldedMatrix:
         k = np.arange(self.nx)[:, None] * np.arange(self.nx)[None, :]
-        return _dev(np.sin(2.0 * np.pi * k / self.nx))
+        return FoldedMatrix(np.sin(2.0 * np.pi * k / self.nx), _dev)
 
     # -- transforms ----------------------------------------------------------
 
@@ -798,13 +800,14 @@ class BiPeriodicSpace2:
         if self.method == "fft":
             c = jnp.fft.fft(jnp.fft.rfft(v, axis=1) / self.ny, axis=0) / self.nx
             return jnp.stack([c.real, c.imag]).astype(v.dtype)
-        w = v @ self._y_fwd.T  # (nx, 2my): [Re | Im] blocks of the y-r2c
+        w = self._y_fwd.apply(v, 1)  # (nx, 2my): [Re | Im] blocks of the y-r2c
         re1, im1 = w[:, : self.my], w[:, self.my :]
         # x-axis c2c forward F = C - iS applied to re1 + i*im1
         # forward c2c matrices are the backward pair scaled by 1/nx — share
         # the device constants and fold the scalar in here
-        re = (self._x_cos @ re1 + self._x_sin @ im1) / self.nx
-        im = (self._x_cos @ im1 - self._x_sin @ re1) / self.nx
+        cos, sin = self._x_cos, self._x_sin
+        re = (cos.apply(re1, 0) + sin.apply(im1, 0)) / self.nx
+        im = (cos.apply(im1, 0) - sin.apply(re1, 0)) / self.nx
         return jnp.stack([re, im])
 
     def backward(self, s):
@@ -814,11 +817,12 @@ class BiPeriodicSpace2:
             mid = jnp.fft.ifft(c * self.nx, axis=0)
             return jnp.fft.irfft(mid * self.ny, n=self.ny, axis=1).astype(s.dtype)
         # x-axis inverse c2c B = C + iS
-        mid_re = self._x_cos @ s[0] - self._x_sin @ s[1]
-        mid_im = self._x_cos @ s[1] + self._x_sin @ s[0]
+        cos, sin = self._x_cos, self._x_sin
+        mid_re = cos.apply(s[0], 0) - sin.apply(s[1], 0)
+        mid_im = cos.apply(s[1], 0) + sin.apply(s[0], 0)
         # y-axis r2c synthesis on the [Re | Im] blocks (imag part of the
         # physical signal is structurally zero and never materialized)
-        return jnp.concatenate([mid_re, mid_im], axis=1) @ self._y_bwd.T
+        return self._y_bwd.apply(jnp.concatenate([mid_re, mid_im], axis=1), 1)
 
     # -- spectral operators --------------------------------------------------
 

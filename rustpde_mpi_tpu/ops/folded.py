@@ -188,6 +188,13 @@ def _detect(mat: np.ndarray):
         mask = (j + k + shift) % 2 == 1
         if np.abs(mat[mask]).max(initial=0.0) < _ATOL * scale:
             return _CheckerFold(mat, shift)
+    # circular (Fourier) reflection folds
+    cls = _classify_circular(mat, on_rows=True)
+    if cls is not None:
+        return _CircAnalysisFold(mat, *cls)
+    cls = _classify_circular(mat, on_rows=False)
+    if cls is not None:
+        return _CircSynthesisFold(mat, *cls)
     return _Plain(mat)
 
 
@@ -217,3 +224,95 @@ class FoldedMatrix:
 
     def apply(self, a, axis: int):
         return self._impl.apply(self._dev, a, axis)
+
+
+class _CircAnalysisFold:
+    """Circular input fold: columns pair under j -> (n-j) mod n and every
+    output row is symmetric (+) or antisymmetric (-) across that pairing —
+    the structure of the split-Fourier forward matrices (cos rows +, sin
+    rows -; fixed points j=0 and, for even n, j=n/2)."""
+
+    kind = "circ_analysis"
+
+    def __init__(self, mat: np.ndarray, rows_s: np.ndarray, rows_a: np.ndarray):
+        r, n = mat.shape
+        self.r = r
+        fixed = [0] + ([n // 2] if n % 2 == 0 else [])
+        pair = np.arange(1, (n - 1) // 2 + 1)
+        self._fixed = np.asarray(fixed)
+        self._pair = pair
+        self._partner = n - pair
+        # inverse permutation scattering concat(y_s, y_a) back to row order
+        perm = np.concatenate([rows_s, rows_a])
+        self._inv = np.argsort(perm)
+        self.m_e = mat[np.ix_(rows_s, np.concatenate([self._fixed, pair]))]
+        self.m_o = mat[np.ix_(rows_a, pair)] if rows_a.size else None
+        self.flops_factor = 0.5
+
+    def device_parts(self, to_dev):
+        return (to_dev(self.m_e), to_dev(self.m_o) if self.m_o is not None else None)
+
+    def apply(self, dev, a, axis: int):
+        m_e, m_o = dev
+        x = _move(a, axis)
+        u = jnp.concatenate([x[self._fixed], x[self._pair] + x[self._partner]])
+        parts = [jnp.tensordot(m_e, u, axes=([1], [0]))]
+        if m_o is not None:
+            v = x[self._pair] - x[self._partner]
+            parts.append(jnp.tensordot(m_o, v, axes=([1], [0])))
+        out = jnp.concatenate(parts, axis=0)[self._inv]
+        return _unmove(out, axis)
+
+
+class _CircSynthesisFold:
+    """Circular output fold: rows pair under i -> (n-i) mod n, each input
+    column symmetric (+) or antisymmetric (-) — the split-Fourier backward
+    matrices (cos columns +, sin columns -)."""
+
+    kind = "circ_synthesis"
+
+    def __init__(self, mat: np.ndarray, cols_s: np.ndarray, cols_a: np.ndarray):
+        n, c = mat.shape
+        self.n = n
+        keep = n // 2 + 1  # rows 0..n//2 inclusive
+        self._cols_s = cols_s
+        self._cols_a = cols_a
+        self.m_e = mat[np.ix_(np.arange(keep), cols_s)]
+        self.m_o = mat[np.ix_(np.arange(keep), cols_a)] if cols_a.size else None
+        # bottom rows n-1..n//2+1 mirror i = 1..ceil(n/2)-1
+        self._mirror = np.arange(1, (n + 1) // 2)[::-1]
+        self.flops_factor = 0.5
+
+    def device_parts(self, to_dev):
+        return (to_dev(self.m_e), to_dev(self.m_o) if self.m_o is not None else None)
+
+    def apply(self, dev, a, axis: int):
+        m_e, m_o = dev
+        x = _move(a, axis)
+        A = jnp.tensordot(m_e, x[self._cols_s], axes=([1], [0]))
+        if m_o is not None:
+            B = jnp.tensordot(m_o, x[self._cols_a], axes=([1], [0]))
+            top, bottom = A + B, A - B
+        else:
+            top = bottom = A
+        out = jnp.concatenate([top, bottom[self._mirror]], axis=0)
+        return _unmove(out, axis)
+
+
+def _classify_circular(mat: np.ndarray, on_rows: bool):
+    """Partition rows (on_rows=False: columns) into symmetric/antisymmetric
+    classes under the circular reflection of the other index; None if any
+    vector is neither."""
+    m = mat if on_rows else mat.T  # classify rows of m under column pairing
+    r, n = m.shape
+    idx = (-np.arange(n)) % n
+    refl = m[:, idx]
+    scale = np.abs(m).max() or 1.0
+    sym = np.abs(refl - m).max(axis=1) < _ATOL * scale
+    asym = np.abs(refl + m).max(axis=1) < _ATOL * scale
+    if not np.all(sym | asym):
+        return None
+    # ambiguous (zero) vectors count as symmetric
+    rows_s = np.where(sym)[0]
+    rows_a = np.where(~sym & asym)[0]
+    return rows_s, rows_a

@@ -224,9 +224,7 @@ class TensorSolver:
         self.lam = lam
         self.alpha = alpha
         self.matvec1 = (
-            FoldedMatrix(precond1, lambda m: jnp.asarray(m, dtype=dt))
-            if precond1 is not None
-            else None
+            FoldedMatrix(precond1, to_dev) if precond1 is not None else None
         )
         # (A_y + (lam_i + alpha) C_y) factored for every eigenvalue lane i
         mats = a1[None, :, :] + (lam[:, None, None] + alpha) * c1[None, :, :]

@@ -161,12 +161,25 @@ def _analytic_step_flops(model) -> float:
         + 3 * 3  # ADI solves (precond matvecs + inverse GEMMs)
         + 4  # fast-diag Poisson (parity-interleaved modal maps)
     )
-    # with folding on, pure-Chebyshev GEMMs run as two half GEMMs; a
-    # periodic model's x-axis runs split-Fourier matmuls that do NOT fold
-    # (~half the per-GEMM work stays full-size -> factor 0.75).  Mixed-BC
-    # "hc" y-bases also stay plain and are slightly underestimated.
+    # folding factor from the matrices the model actually built: average the
+    # per-matrix flops_factor over the transform pair of each variable space
+    # (split-Fourier axes and mixed-BC bases report 1.0 or fold their own
+    # way, so "hc"/periodic models are accounted correctly)
     if folding_enabled():
-        factor = 0.75 if getattr(model, "periodic", False) else 0.5
+        factors = []
+        for attr in ("temp_space", "velx_space", "field_space"):
+            space = getattr(model, attr, None)
+            if space is None:
+                continue
+            for base in getattr(space, "bases", ()):
+                for mat_attr in ("_fwd_matrix", "_bwd_matrix", "_fwd_dev", "_bwd_dev"):
+                    try:
+                        fm = getattr(base, mat_attr)
+                    except (ValueError, AttributeError):
+                        continue
+                    if hasattr(fm, "flops_factor"):
+                        factors.append(fm.flops_factor)
+        factor = float(np.mean(factors)) if factors else 0.5
     else:
         factor = 1.0
     return gemms * factor * 2.0 * n**3

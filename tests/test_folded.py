@@ -153,3 +153,18 @@ def test_modal_maps_fold_with_parity_interleaved_eig():
     impl = solver._solver
     if isinstance(impl, FastDiag):
         assert impl.fwd[0].flops_factor == 0.5
+
+
+def test_circular_folds_on_fourier_matrices():
+    """Split-Fourier and DFT cos/sin matrices fold under the circular
+    reflection j -> (n-j) mod n, for even and odd n."""
+    from rustpde_mpi_tpu.ops import fourier as fou
+
+    for n in (16, 17):
+        fwd = _check(fou.split_forward_matrix(n), "circ_analysis")
+        assert fwd.flops_factor == 0.5
+        bwd = _check(fou.split_backward_matrix(n), "circ_synthesis")
+        assert bwd.flops_factor == 0.5
+        k = np.arange(n)[:, None] * np.arange(n)[None, :]
+        _check(np.cos(2 * np.pi * k / n), "circ_analysis")
+        _check(np.sin(2 * np.pi * k / n), "circ_analysis")

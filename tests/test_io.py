@@ -143,7 +143,6 @@ def test_field2_readwrite_trait(tmp_path):
     space2 = Space2(fourier_r2c(32), cheb_dirichlet(17))
     h = Field2(space2)
     h.read(fname, "temp")
-    x2 = space2.bases[0].points
     # the coarse field evaluated on the fine grid: compare at shared points
     np.testing.assert_allclose(
         np.asarray(h.v)[::2, :], np.asarray(f.v), atol=1e-10
