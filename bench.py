@@ -89,7 +89,7 @@ def bench_poisson(n, solves=32):
     return {"solves_per_sec": solves / elapsed, "max_error": err, "n": n}
 
 
-def bench_sh(nx, steps=32):
+def bench_sh(nx, steps=128):
     from rustpde_mpi_tpu import SwiftHohenberg2D
     from rustpde_mpi_tpu.utils.profiling import benchmark_steps
 
@@ -114,14 +114,17 @@ def main() -> int:
         t0 = time.perf_counter()
         try:
             if name == "rbc129":
-                r = bench_navier(129, 129, 1e7, 2e-3, steps)
+                # small configs need a longer timed window: 64 steps is an
+                # ~100 ms measurement through the relay, dominated by noise
+                r = bench_navier(129, 129, 1e7, 2e-3, max(steps, 256))
             elif name == "rbc129_f64":
                 env = dict(os.environ, RUSTPDE_X64="1")
                 import subprocess
 
+                f64_steps = max(steps, 256)
                 code = (
                     "import bench, json;"
-                    "print(json.dumps(bench.bench_navier(129,129,1e7,2e-3,32)))"
+                    f"print(json.dumps(bench.bench_navier(129,129,1e7,2e-3,{f64_steps})))"
                 )
                 out = subprocess.run(
                     [sys.executable, "-c", code],
@@ -130,7 +133,7 @@ def main() -> int:
                 )
                 r = json.loads(out.stdout.strip().splitlines()[-1])
             elif name == "periodic":
-                r = bench_navier(128, 65, 1e6, 1e-2, steps, periodic=True)
+                r = bench_navier(128, 65, 1e6, 1e-2, max(steps, 256), periodic=True)
             elif name == "poisson1025":
                 r = bench_poisson(1025)
             elif name == "rbc1025":

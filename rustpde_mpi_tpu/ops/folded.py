@@ -30,6 +30,7 @@ import jax.numpy as jnp
 import numpy as np
 
 _ATOL = 1e-11
+_CIRC_MIN_DIM = 256  # circular folds engage only for large transforms
 
 
 def folding_enabled() -> bool:
@@ -188,13 +189,25 @@ def _detect(mat: np.ndarray):
         mask = (j + k + shift) % 2 == 1
         if np.abs(mat[mask]).max(initial=0.0) < _ATOL * scale:
             return _CheckerFold(mat, shift)
-    # circular (Fourier) reflection folds
-    cls = _classify_circular(mat, on_rows=True)
-    if cls is not None:
-        return _CircAnalysisFold(mat, *cls)
-    cls = _classify_circular(mat, on_rows=False)
-    if cls is not None:
-        return _CircSynthesisFold(mat, *cls)
+    # circular (Fourier) reflection folds.  Size-gated: the index gathers
+    # they add are pure overhead on dispatch-bound small GEMMs (measured:
+    # the 128x65 periodic config runs faster plain), while at SH-2048-class
+    # sizes the flop saving dominates.
+    if min(r, c) < _CIRC_MIN_DIM:
+        return _Plain(mat)
+    cls_in = _classify_circular(mat, on_rows=True)
+    cls_out = _classify_circular(mat, on_rows=False)
+    if cls_in is not None and cls_out is not None and r == c:
+        # single global output class -> rows mirror with one sign: quarter fold
+        cols_s, cols_a = cls_out
+        if cols_a.size == 0:
+            return _CircBothFold(mat, +1.0)
+        if cols_s.size == 0 or np.abs(mat[:, cols_s]).max(initial=0.0) < _ATOL * scale:
+            return _CircBothFold(mat, -1.0)
+    if cls_in is not None:
+        return _CircAnalysisFold(mat, *cls_in)
+    if cls_out is not None:
+        return _CircSynthesisFold(mat, *cls_out)
     return _Plain(mat)
 
 
@@ -209,10 +222,17 @@ class FoldedMatrix:
         self._impl = _detect(np.asarray(mat))
         self._dev = self._impl.device_parts(to_dev)
         # drop the host copies — apply() reads only the device parts and the
-        # scalar shape metadata (at 2049^2 f64 a retained inverse is ~33 MB)
-        for attr in ("mat", "m_e", "m_o"):
-            if hasattr(self._impl, attr):
-                setattr(self._impl, attr, None)
+        # scalar shape metadata (at 2049^2 f64 a retained inverse is ~33 MB);
+        # recurse into wrapped impls (_CircBothFold holds an inner fold)
+        stack = [self._impl]
+        while stack:
+            impl = stack.pop()
+            for attr in ("mat", "m_e", "m_o"):
+                if hasattr(impl, attr):
+                    setattr(impl, attr, None)
+            inner = getattr(impl, "_inner", None)
+            if inner is not None:
+                stack.append(inner)
 
     @property
     def kind(self) -> str:
@@ -316,3 +336,34 @@ def _classify_circular(mat: np.ndarray, on_rows: bool):
     rows_s = np.where(sym)[0]
     rows_a = np.where(~sym & asym)[0]
     return rows_s, rows_a
+
+
+class _CircBothFold:
+    """Quarter-flops circular fold for matrices with BOTH circular
+    symmetries and a single output class: input columns pair under
+    j -> (n-j) mod n (per-row sym/antisym), and every output row mirrors as
+    ``M[(n-i) mod n, :] = t * M[i, :]`` with one global sign t — the DFT
+    cos (t=+1) and sin (t=-1) matrices.  Computes the kept rows 0..n//2 via
+    the half-input fold, then mirrors the bottom rows."""
+
+    kind = "circ_both"
+
+    def __init__(self, mat: np.ndarray, sign: float):
+        n = mat.shape[0]
+        keep = n // 2 + 1
+        kept = mat[:keep]
+        cls = _classify_circular(kept, on_rows=True)
+        self._inner = _CircAnalysisFold(kept, *cls)
+        self._sign = sign
+        self._mirror = np.arange(1, (n + 1) // 2)[::-1]
+        self.flops_factor = 0.25
+        # host copies live on self._inner; FoldedMatrix's cleanup recurses
+
+    def device_parts(self, to_dev):
+        return self._inner.device_parts(to_dev)
+
+    def apply(self, dev, a, axis: int):
+        x = _move(a, axis)
+        top = self._inner.apply(dev, x, 0)
+        bottom = self._sign * top[self._mirror]
+        return _unmove(jnp.concatenate([top, bottom], axis=0), axis)

@@ -155,16 +155,43 @@ def test_modal_maps_fold_with_parity_interleaved_eig():
         assert impl.fwd[0].flops_factor == 0.5
 
 
-def test_circular_folds_on_fourier_matrices():
+def test_circular_folds_on_fourier_matrices(monkeypatch):
     """Split-Fourier and DFT cos/sin matrices fold under the circular
-    reflection j -> (n-j) mod n, for even and odd n."""
-    from rustpde_mpi_tpu.ops import fourier as fou
+    reflection j -> (n-j) mod n, for even and odd n (gate lowered so the
+    small unit sizes exercise the fold math)."""
+    from rustpde_mpi_tpu.ops import folded, fourier as fou
 
+    monkeypatch.setattr(folded, "_CIRC_MIN_DIM", 4)
     for n in (16, 17):
         fwd = _check(fou.split_forward_matrix(n), "circ_analysis")
         assert fwd.flops_factor == 0.5
         bwd = _check(fou.split_backward_matrix(n), "circ_synthesis")
         assert bwd.flops_factor == 0.5
+
+
+def test_circ_both_quarter_fold_on_dft_matrices(monkeypatch):
+    """DFT cos/sin matrices carry both circular symmetries with one output
+    sign -> quarter-flops fold."""
+    from rustpde_mpi_tpu.ops import folded
+
+    monkeypatch.setattr(folded, "_CIRC_MIN_DIM", 4)
+    for n in (16, 17):
         k = np.arange(n)[:, None] * np.arange(n)[None, :]
-        _check(np.cos(2 * np.pi * k / n), "circ_analysis")
-        _check(np.sin(2 * np.pi * k / n), "circ_analysis")
+        cos = _check(np.cos(2 * np.pi * k / n), "circ_both")
+        sin = _check(np.sin(2 * np.pi * k / n), "circ_both")
+        assert cos.flops_factor == 0.25
+        assert sin.flops_factor == 0.25
+
+
+def test_circular_fold_size_gate():
+    """Below the size gate the circular families stay plain (their gathers
+    cost more than the saved flops on dispatch-bound small GEMMs); at
+    transform scale they engage."""
+    from rustpde_mpi_tpu.ops import fourier as fou
+
+    small = FoldedMatrix(fou.split_forward_matrix(64), _dev)
+    assert small.kind == "plain"
+    big = FoldedMatrix(fou.split_forward_matrix(512), _dev)
+    assert big.kind == "circ_analysis"
+    k = np.arange(256)[:, None] * np.arange(256)[None, :]
+    assert FoldedMatrix(np.cos(2 * np.pi * k / 256), _dev).kind == "circ_both"
