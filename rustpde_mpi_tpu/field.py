@@ -14,7 +14,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from . import config
-from .bases import Base, BaseKind, Space2
+from .bases import BaseKind, Space2
 
 
 def grid_deltas(x: np.ndarray, periodic: bool) -> np.ndarray:

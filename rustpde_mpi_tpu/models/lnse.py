@@ -28,14 +28,12 @@ round-trip happens only at the energy evaluation between them.
 
 from __future__ import annotations
 
-from typing import NamedTuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
 from .. import config
-from ..field import norm_l2
 from ..utils.integrate import Integrate
 from .meanfield import MeanFields
 from .navier import Navier2D, NavierState

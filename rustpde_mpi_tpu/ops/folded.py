@@ -196,7 +196,13 @@ def _detect(mat: np.ndarray):
     if min(r, c) < _CIRC_MIN_DIM:
         return _Plain(mat)
     cls_in = _classify_circular(mat, on_rows=True)
-    cls_out = _classify_circular(mat, on_rows=False)
+    # the column classification is only needed for the square quarter-fold
+    # candidates and the synthesis fallback — skip the O(r*c) pass otherwise
+    cls_out = (
+        _classify_circular(mat, on_rows=False)
+        if (r == c and cls_in is not None) or cls_in is None
+        else None
+    )
     if cls_in is not None and cls_out is not None and r == c:
         # single global output class -> rows mirror with one sign: quarter fold
         cols_s, cols_a = cls_out

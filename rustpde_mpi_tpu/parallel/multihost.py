@@ -29,7 +29,8 @@ import os
 import jax
 import numpy as np
 
-from .mesh import AXIS, make_mesh
+from .mesh import make_mesh
+
 
 def _cluster_env_configured() -> bool:
     """True when the environment really describes a multi-host cluster — an

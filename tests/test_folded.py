@@ -187,11 +187,12 @@ def test_circular_fold_size_gate():
     """Below the size gate the circular families stay plain (their gathers
     cost more than the saved flops on dispatch-bound small GEMMs); at
     transform scale they engage."""
-    from rustpde_mpi_tpu.ops import fourier as fou
+    from rustpde_mpi_tpu.ops import folded, fourier as fou
 
-    small = FoldedMatrix(fou.split_forward_matrix(64), _dev)
+    gate = folded._CIRC_MIN_DIM
+    small = FoldedMatrix(fou.split_forward_matrix(gate // 2), _dev)
     assert small.kind == "plain"
-    big = FoldedMatrix(fou.split_forward_matrix(512), _dev)
+    big = FoldedMatrix(fou.split_forward_matrix(2 * gate), _dev)
     assert big.kind == "circ_analysis"
-    k = np.arange(256)[:, None] * np.arange(256)[None, :]
-    assert FoldedMatrix(np.cos(2 * np.pi * k / 256), _dev).kind == "circ_both"
+    k = np.arange(gate)[:, None] * np.arange(gate)[None, :]
+    assert FoldedMatrix(np.cos(2 * np.pi * k / gate), _dev).kind == "circ_both"

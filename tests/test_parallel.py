@@ -12,7 +12,7 @@ import pytest
 
 import rustpde_mpi_tpu as rp
 from rustpde_mpi_tpu import Navier2D
-from rustpde_mpi_tpu.parallel import PHYS, SPEC, make_mesh, use_mesh
+from rustpde_mpi_tpu.parallel import make_mesh, use_mesh
 from rustpde_mpi_tpu.solver import Poisson
 
 

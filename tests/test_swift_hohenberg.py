@@ -10,7 +10,6 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
-from rustpde_mpi_tpu import config
 from rustpde_mpi_tpu.bases import (
     BiPeriodicSpace2,
     Space1,

@@ -9,7 +9,6 @@ import numpy as np
 import pytest
 
 from rustpde_mpi_tpu.tools import ParticleSwarm, create_xmf, native_available
-from rustpde_mpi_tpu.tools.particle_tracer import _advect_numpy
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 

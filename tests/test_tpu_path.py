@@ -24,7 +24,7 @@ def tpu_path(monkeypatch):
 
 def test_forced_path_selects_tpu_defaults(tpu_path):
     from rustpde_mpi_tpu import config
-    from rustpde_mpi_tpu.solver import FastDiag, _TensorBased, default_method
+    from rustpde_mpi_tpu.solver import FastDiag, default_method
 
     assert config.is_tpu_like()
     assert default_method() == "dense"
