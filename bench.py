@@ -33,13 +33,15 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 # host CPU, 2026-07-29 (BASELINE.md "Measured stand-in baseline").
 CPU_BASELINE_STEPS_PER_SEC = 0.188
 
+# primary config first: with a driver-side timeout or the RUSTPDE_BENCH_BUDGET_S
+# cutoff, whatever completes still yields the primary metric line
 DEFAULT_CONFIGS = [
-    "rbc129",
-    "rbc129_f64",
-    "periodic",
-    "poisson1025",
     "rbc1025",
     "sh2048",
+    "rbc129",
+    "periodic",
+    "poisson1025",
+    "rbc129_f64",
 ]
 
 
@@ -108,9 +110,18 @@ def main() -> int:
     names = DEFAULT_CONFIGS if sel == "all" else [s.strip() for s in sel.split(",")]
     steps = int(os.environ.get("RUSTPDE_BENCH_STEPS", "64"))
 
+    # wall budget: stop starting new configs once exceeded so the JSON line
+    # is always emitted even under an external timeout; completed configs
+    # merge into BENCH_FULL.json, so successive runs fill the matrix
+    budget = float(os.environ.get("RUSTPDE_BENCH_BUDGET_S", "420"))
+    bench_start = time.perf_counter()
+
     results: dict[str, dict] = {}
     ok = True
     for name in names:
+        if time.perf_counter() - bench_start > budget and results:
+            print(f"# budget {budget:.0f}s exhausted; skipping {name}", file=sys.stderr)
+            continue
         t0 = time.perf_counter()
         try:
             if name == "rbc129":
