@@ -112,15 +112,40 @@ def main() -> int:
 
     # wall budget: stop starting new configs once exceeded so the JSON line
     # is always emitted even under an external timeout; completed configs
-    # merge into BENCH_FULL.json, so successive runs fill the matrix
+    # merge into BENCH_FULL.json.  To keep the whole matrix fresh across
+    # budgeted runs, the non-primary configs run least-recently-measured
+    # first (per-entry 'seq' counters persisted in BENCH_FULL.json) — each
+    # run picks up where the previous one was cut off.
     budget = float(os.environ.get("RUSTPDE_BENCH_BUDGET_S", "420"))
     bench_start = time.perf_counter()
 
+    prev_results: dict = {}
+    try:
+        with open("BENCH_FULL.json") as f:
+            prev = json.load(f)
+        if prev.get("platform") == platform and isinstance(prev.get("results"), dict):
+            prev_results = prev["results"]
+    except (OSError, ValueError):
+        pass
+    seq = 1 + max(
+        (v.get("seq", 0) for v in prev_results.values() if isinstance(v, dict)),
+        default=0,
+    )
+    if sel == "all":
+        head = [n for n in names if n == "rbc1025"]
+        tail = sorted(
+            (n for n in names if n != "rbc1025"),
+            key=lambda n: prev_results.get(n, {}).get("seq", 0),
+        )
+        names = head + tail
+
     results: dict[str, dict] = {}
+    skipped_for_budget: list[str] = []
     ok = True
     for name in names:
         if time.perf_counter() - bench_start > budget and results:
             print(f"# budget {budget:.0f}s exhausted; skipping {name}", file=sys.stderr)
+            skipped_for_budget.append(name)
             continue
         t0 = time.perf_counter()
         try:
@@ -157,6 +182,7 @@ def main() -> int:
                 print(f"unknown config {name}", file=sys.stderr)
                 continue
             r["bench_wall_s"] = round(time.perf_counter() - t0, 1)
+            r["seq"] = seq
             results[name] = r
             ok = ok and r.get("finite", True)
         except Exception as exc:  # record the failure, keep benching
@@ -214,6 +240,7 @@ def main() -> int:
         "unit": unit,
         "vs_baseline": round(vs, 2),
         "mfu": round(mfu, 4) if mfu is not None else None,
+        "skipped_for_budget": skipped_for_budget,
         "configs": {
             k: {
                 kk: denan(round(vv, 4) if isinstance(vv, float) else vv)
@@ -227,17 +254,11 @@ def main() -> int:
         k: {kk: denan(vv) for kk, vv in v.items()} if isinstance(v, dict) else v
         for k, v in results.items()
     }
-    # merge into the existing record so a subset run updates its configs
-    # without deleting the rest of the matrix — but never mix platforms
-    # (a CPU subset run must not get attributed TPU numbers or vice versa)
-    record: dict = {"platform": platform, "results": {}}
-    try:
-        with open("BENCH_FULL.json") as f:
-            prev = json.load(f)
-        if prev.get("platform") == platform and isinstance(prev.get("results"), dict):
-            record["results"].update(prev["results"])
-    except (OSError, ValueError):
-        pass
+    # merge into the existing record so a subset/budgeted run updates its
+    # configs without deleting the rest of the matrix — but never mix
+    # platforms (a CPU run must not get attributed TPU numbers or vice
+    # versa); per-entry 'seq' marks how fresh each number is
+    record: dict = {"platform": platform, "results": dict(prev_results)}
     record["results"].update(sanitized)
     with open("BENCH_FULL.json", "w") as f:
         json.dump(record, f, indent=1, default=str)
