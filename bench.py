@@ -226,8 +226,13 @@ def main() -> int:
     ).endswith("_f64")
 
     def denan(v):
-        if isinstance(v, float) and v != v:
-            return None  # NaN is not valid strict JSON
+        """Recursive NaN/inf -> None (bare NaN literals are not strict JSON)."""
+        if isinstance(v, float) and (v != v or v in (float("inf"), float("-inf"))):
+            return None
+        if isinstance(v, dict):
+            return {k: denan(x) for k, x in v.items()}
+        if isinstance(v, (list, tuple)):
+            return [denan(x) for x in v]
         return v
 
     payload = {
@@ -241,19 +246,18 @@ def main() -> int:
         "vs_baseline": round(vs, 2),
         "mfu": round(mfu, 4) if mfu is not None else None,
         "skipped_for_budget": skipped_for_budget,
-        "configs": {
-            k: {
-                kk: denan(round(vv, 4) if isinstance(vv, float) else vv)
-                for kk, vv in v.items()
-                if kk != "mfu"
+        "configs": denan(
+            {
+                k: {
+                    kk: (round(vv, 4) if isinstance(vv, float) else vv)
+                    for kk, vv in v.items()
+                    if kk != "mfu"
+                }
+                for k, v in results.items()
             }
-            for k, v in results.items()
-        },
+        ),
     }
-    sanitized = {
-        k: {kk: denan(vv) for kk, vv in v.items()} if isinstance(v, dict) else v
-        for k, v in results.items()
-    }
+    sanitized = denan(results)
     # merge into the existing record so a subset/budgeted run updates its
     # configs without deleting the rest of the matrix — but never mix
     # platforms (a CPU run must not get attributed TPU numbers or vice

@@ -18,6 +18,7 @@ plain arrays.
 from __future__ import annotations
 
 import enum
+import os
 from functools import cached_property
 
 import jax
@@ -27,6 +28,7 @@ import numpy as np
 from . import config
 from .ops import chebyshev as chb
 from .ops import fourier as fou
+from .ops import fourstep
 from .ops import transforms as tr
 from .ops.folded import FoldedMatrix
 
@@ -60,6 +62,22 @@ class BaseKind(enum.Enum):
     @property
     def is_split(self) -> bool:
         return self == BaseKind.FOURIER_R2C_SPLIT
+
+
+_FAST_DERIV = os.environ.get("RUSTPDE_FAST_DERIV", "auto")
+_FAST_DERIV_MIN = int(os.environ.get("RUSTPDE_FAST_DERIV_MIN", "512"))
+
+
+def _fast_deriv_enabled(n: int) -> bool:
+    """Chebyshev derivatives via the parity-cumsum recurrence
+    (ops/transforms.cheb_derivative) instead of dense triangular GEMMs.
+    ``RUSTPDE_FAST_DERIV``: "auto" (default; engages at n >= 512 where the
+    GEMM flops dominate dispatch), "1" (always), "0" (never)."""
+    if _FAST_DERIV == "0":
+        return False
+    if _FAST_DERIV == "1":
+        return True
+    return n >= _FAST_DERIV_MIN
 
 
 def _dev(mat: np.ndarray):
@@ -229,6 +247,51 @@ class Base:
     def _synthesis_dev(self) -> FoldedMatrix:
         return FoldedMatrix(chb.synthesis_matrix(self.n), _dev)
 
+    # -- four-step fast DCT path (ops/fourstep.py) ---------------------------
+    #
+    # Both Chebyshev transform directions are diagonal scalings around the
+    # size-(N+1) cosine kernel, which factors through a length-2N four-step
+    # real DFT: O(n^1.5) MXU flops instead of the O(n^2) dense matrices the
+    # funspace reference pays rustdct to avoid (SURVEY.md S2.2).
+
+    @cached_property
+    def _dct_plan(self):
+        N = self.n - 1
+        if N < 2 or not fourstep.enabled(2 * N):
+            return None
+        return fourstep.Dct1Plan(self.n, _dev)
+
+    @cached_property
+    def _dct_diags(self):
+        """(sigma*(-1)^k analysis row scale, (-1)^k signs) device constants;
+        reshaped for axis-0 broadcasting at the call sites."""
+        n = self.n
+        N = n - 1
+        sigma = np.full(n, 1.0 / N)
+        sigma[0] = sigma[-1] = 1.0 / (2.0 * N)
+        signs = (-1.0) ** np.arange(n)
+        return _dev(sigma * signs), _dev(signs)
+
+    def _fast_analysis(self, v, axis: int):
+        """uhat = analysis_matrix @ u == sigma*(-1)^k * Re(rfft(ext(u)))."""
+        x = jnp.moveaxis(v, axis, 0)
+        row_scale, _ = self._dct_diags
+        out = self._dct_plan.apply(x)
+        out = out * row_scale.reshape((self.n,) + (1,) * (out.ndim - 1)).astype(
+            out.real.dtype
+        )
+        return jnp.moveaxis(out, 0, axis)
+
+    def _fast_synthesis(self, c, axis: int):
+        """u = synthesis_matrix @ c via the same cosine core:
+        with g = (-1)^k * c,  u_j = 0.5*core(g)_j + 0.5*(g_0 + (-1)^j g_N)."""
+        x = jnp.moveaxis(c, axis, 0)
+        _, signs = self._dct_diags
+        sg = signs.reshape((self.n,) + (1,) * (x.ndim - 1)).astype(x.real.dtype)
+        g = x * sg
+        out = 0.5 * self._dct_plan.apply(g) + 0.5 * (g[0][None] + sg * g[-1][None])
+        return jnp.moveaxis(out, 0, axis)
+
     def _gradient_dev(self, order: int):
         """Chebyshev: FoldedMatrix; Fourier: cached device diagonal."""
         if order not in self._grad_dev_cache:
@@ -242,6 +305,12 @@ class Base:
         """Physical -> (composite) spectral along ``axis``."""
         if self.kind.is_chebyshev:
             if method == "matmul":
+                if self.kind == BaseKind.CHEBYSHEV and self._dct_plan is not None:
+                    # pure base: projection is the identity, so the whole
+                    # forward is the fast DCT core (composite bases keep the
+                    # fused dense P @ F GEMM — P is dense-checkerboard, so
+                    # splitting it out would not reduce flops)
+                    return self._fast_analysis(v, axis)
                 return self._fwd_matrix.apply(v, axis)
             c = tr.cheb_forward_fft(v, axis)
             return self.from_ortho(c, axis)
@@ -253,6 +322,10 @@ class Base:
         """(Composite) spectral -> physical along ``axis``."""
         if self.kind.is_chebyshev:
             if method == "matmul":
+                if self._dct_plan is not None:
+                    # banded stencil + fast DCT synthesis — cheaper than the
+                    # fused dense synthesis @ S GEMM in every composite case
+                    return self._fast_synthesis(self.to_ortho(vhat, axis), axis)
                 return self._bwd_matrix.apply(vhat, axis)
             return tr.cheb_backward_fft(self.to_ortho(vhat, axis), axis)
         if self.kind == BaseKind.FOURIER_R2C:
@@ -264,6 +337,8 @@ class Base:
         ``axis`` (no composite cast — gradients already live in ortho space)."""
         if self.kind.is_chebyshev:
             if method == "matmul":
+                if self._dct_plan is not None:
+                    return self._fast_synthesis(c, axis)
                 return self._synthesis_dev.apply(c, axis)
             return tr.cheb_backward_fft(c, axis)
         if self.kind == BaseKind.FOURIER_R2C:
@@ -285,6 +360,10 @@ class Base:
         if order == 0:
             return self.to_ortho(vhat, axis)
         if self.kind.is_chebyshev:
+            if _fast_deriv_enabled(self.n):
+                # banded stencil + parity-cumsum recurrence: O(n) per lane
+                # instead of the dense upper-triangular D^order @ S GEMM
+                return tr.cheb_derivative(self.to_ortho(vhat, axis), order, axis)
             return self._gradient_dev(order).apply(vhat, axis)
         return tr.apply_diag(self._gradient_dev(order), vhat, axis)
 
@@ -342,12 +421,31 @@ class SplitFourierBase(Base):
     def _bwd_dev(self) -> FoldedMatrix:
         return FoldedMatrix(fou.split_backward_matrix(self.n), _dev)
 
+    @cached_property
+    def _rfft_plan(self):
+        if not fourstep.enabled(self.n):
+            return None
+        return fourstep.RfftPlan(self.n, _dev)
+
+    @cached_property
+    def _irfft_plan(self):
+        if not fourstep.enabled(self.n):
+            return None
+        return fourstep.IrfftPlan(self.n, _dev)
+
     def forward(self, v, axis: int, method: str = "matmul"):
         del method  # matmul is the only (and native) path
+        if self._rfft_plan is not None:
+            x = jnp.moveaxis(v, axis, 0)
+            out = self._rfft_plan.split(x) / self.n
+            return jnp.moveaxis(out, 0, axis)
         return self._fwd_dev.apply(v, axis)
 
     def backward(self, vhat, axis: int, method: str = "matmul"):
         del method
+        if self._irfft_plan is not None:
+            x = jnp.moveaxis(vhat, axis, 0)
+            return jnp.moveaxis(self._irfft_plan.apply(x), 0, axis)
         return self._bwd_dev.apply(vhat, axis)
 
     def backward_ortho(self, c, axis: int, method: str = "matmul"):
@@ -785,13 +883,36 @@ class BiPeriodicSpace2:
 
     @cached_property
     def _x_cos(self) -> FoldedMatrix:
-        k = np.arange(self.nx)[:, None] * np.arange(self.nx)[None, :]
-        return FoldedMatrix(np.cos(2.0 * np.pi * k / self.nx), _dev)
+        return FoldedMatrix(fou.dft_cos_matrix(self.nx), _dev)
 
     @cached_property
     def _x_sin(self) -> FoldedMatrix:
-        k = np.arange(self.nx)[:, None] * np.arange(self.nx)[None, :]
-        return FoldedMatrix(np.sin(2.0 * np.pi * k / self.nx), _dev)
+        return FoldedMatrix(fou.dft_sin_matrix(self.nx), _dev)
+
+    # four-step plans (ops/fourstep.py); None below the size gate
+    @cached_property
+    def _y_rfft_plan(self):
+        return fourstep.RfftPlan(self.ny, _dev) if fourstep.enabled(self.ny) else None
+
+    @cached_property
+    def _y_irfft_plan(self):
+        return fourstep.IrfftPlan(self.ny, _dev) if fourstep.enabled(self.ny) else None
+
+    @cached_property
+    def _x_c2c_fwd(self):
+        return (
+            fourstep.C2cPlan(self.nx, _dev, sign=-1.0)
+            if fourstep.enabled(self.nx)
+            else None
+        )
+
+    @cached_property
+    def _x_c2c_bwd(self):
+        return (
+            fourstep.C2cPlan(self.nx, _dev, sign=+1.0)
+            if fourstep.enabled(self.nx)
+            else None
+        )
 
     # -- transforms ----------------------------------------------------------
 
@@ -800,9 +921,17 @@ class BiPeriodicSpace2:
         if self.method == "fft":
             c = jnp.fft.fft(jnp.fft.rfft(v, axis=1) / self.ny, axis=0) / self.nx
             return jnp.stack([c.real, c.imag]).astype(v.dtype)
-        w = self._y_fwd.apply(v, 1)  # (nx, 2my): [Re | Im] blocks of the y-r2c
+        if self._y_rfft_plan is not None:
+            w = jnp.moveaxis(
+                self._y_rfft_plan.split(jnp.moveaxis(v, 1, 0)) / self.ny, 0, 1
+            )
+        else:
+            w = self._y_fwd.apply(v, 1)  # (nx, 2my): [Re | Im] of the y-r2c
         re1, im1 = w[:, : self.my], w[:, self.my :]
         # x-axis c2c forward F = C - iS applied to re1 + i*im1
+        if self._x_c2c_fwd is not None:
+            re, im = self._x_c2c_fwd.apply(re1, im1)
+            return jnp.stack([re / self.nx, im / self.nx])
         # forward c2c matrices are the backward pair scaled by 1/nx — share
         # the device constants and fold the scalar in here
         cos, sin = self._x_cos, self._x_sin
@@ -817,12 +946,20 @@ class BiPeriodicSpace2:
             mid = jnp.fft.ifft(c * self.nx, axis=0)
             return jnp.fft.irfft(mid * self.ny, n=self.ny, axis=1).astype(s.dtype)
         # x-axis inverse c2c B = C + iS
-        cos, sin = self._x_cos, self._x_sin
-        mid_re = cos.apply(s[0], 0) - sin.apply(s[1], 0)
-        mid_im = cos.apply(s[1], 0) + sin.apply(s[0], 0)
+        if self._x_c2c_bwd is not None:
+            mid_re, mid_im = self._x_c2c_bwd.apply(s[0], s[1])
+        else:
+            cos, sin = self._x_cos, self._x_sin
+            mid_re = cos.apply(s[0], 0) - sin.apply(s[1], 0)
+            mid_im = cos.apply(s[1], 0) + sin.apply(s[0], 0)
         # y-axis r2c synthesis on the [Re | Im] blocks (imag part of the
         # physical signal is structurally zero and never materialized)
-        return self._y_bwd.apply(jnp.concatenate([mid_re, mid_im], axis=1), 1)
+        mid = jnp.concatenate([mid_re, mid_im], axis=1)
+        if self._y_irfft_plan is not None:
+            return jnp.moveaxis(
+                self._y_irfft_plan.apply(jnp.moveaxis(mid, 1, 0)), 0, 1
+            )
+        return self._y_bwd.apply(mid, 1)
 
     # -- spectral operators --------------------------------------------------
 

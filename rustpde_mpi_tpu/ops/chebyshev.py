@@ -38,20 +38,46 @@ def cgl_points(n: int) -> np.ndarray:
 
 
 def synthesis_matrix(n: int) -> np.ndarray:
-    """B[j, k] = T_k(x_j) at ascending CGL points (backward transform)."""
-    j = np.arange(n)[:, None]
+    """B[j, k] = T_k(x_j) at ascending CGL points (backward transform).
+
+    The bottom half is mirror-constructed from the top via the exact identity
+    ``B[N-j, k] = (-1)^k B[j, k]`` so the reflection symmetry holds to the
+    *bit* — evaluating cos at both arguments leaves ~1e-13 asymmetry at
+    n >= 1025, below which ops/folded.py's structure detection must not dip."""
+    N = n - 1
+    half = N // 2 + 1
+    j = np.arange(half)[:, None]
     k = np.arange(n)[None, :]
     # T_k(-cos t) = (-1)^k cos(k t)
-    return ((-1.0) ** k) * np.cos(np.pi * k * j / (n - 1))
+    sgn = (-1.0) ** k
+    top = sgn * np.cos(np.pi * k * j / N)
+    if N % 2 == 0:
+        # self-mirror row j = N/2: odd-k entries are cos(pi*k/2) = 0 exactly,
+        # but evaluate to ~1e-13 argument-rounding garbage at large k
+        top[N // 2, 1::2] = 0.0
+    B = np.empty((n, n))
+    B[:half] = top
+    B[half:] = (sgn * top[: n - half])[::-1]
+    return B
 
 
 def analysis_matrix(n: int) -> np.ndarray:
     """F such that ``uhat = F @ u`` (forward transform), exact inverse of
-    :func:`synthesis_matrix` via DCT-I orthogonality (no matrix inversion)."""
+    :func:`synthesis_matrix` via DCT-I orthogonality (no matrix inversion).
+    Right half mirror-constructed from the exact identity
+    ``F[k, N-j] = (-1)^k F[k, j]`` (see :func:`synthesis_matrix`)."""
     N = n - 1
-    j = np.arange(n)[None, :]
+    half = N // 2 + 1
+    j = np.arange(half)[None, :]
     k = np.arange(n)[:, None]
-    F = np.cos(np.pi * k * j / N) * ((-1.0) ** k)
+    sgn = (-1.0) ** k
+    left = sgn * np.cos(np.pi * k * j / N)
+    if N % 2 == 0:
+        # self-mirror column j = N/2 (see synthesis_matrix)
+        left[1::2, N // 2] = 0.0
+    F = np.empty((n, n))
+    F[:, :half] = left
+    F[:, half:] = (sgn * left[:, : n - half])[:, ::-1]
     F[:, 1:-1] *= 2.0
     sigma = np.full(n, 1.0 / N)
     sigma[0] = sigma[-1] = 1.0 / (2.0 * N)

@@ -33,27 +33,91 @@ def split_forward_matrix(n: int) -> np.ndarray:
 
     The split representation is the TPU-native form of the r2c spectrum: the
     axon backend has no complex dtypes and no FFT, so the transform runs as
-    one real MXU matmul over stacked Re/Im blocks."""
+    one real MXU matmul over stacked Re/Im blocks.  The right column half is
+    mirror-constructed from the exact circular identities
+    ``cos(2pi k (n-j)/n) = cos(2pi k j/n)`` / ``sin -> -sin`` so the
+    reflection fold in ops/folded.py detects *exact* structure."""
     m = n // 2 + 1
-    j = np.arange(n)[None, :]
+    half = n // 2 + 1  # columns 0..n//2; the rest mirror j -> n-j
+    j = np.arange(half)[None, :]
     k = np.arange(m)[:, None]
     ang = 2.0 * np.pi * k * j / n
-    return np.concatenate([np.cos(ang), -np.sin(ang)], axis=0) / n
+    cos_l = np.cos(ang)
+    sin_l = -np.sin(ang)
+    if n % 2 == 0:
+        # sin(pi*k) / sin(pi*j) are 0 exactly but evaluate to ~1e-13
+        # argument-rounding garbage: Nyquist column (j = n/2) and, for the
+        # Nyquist row (k = m-1), every column
+        sin_l[:, half - 1] = 0.0
+        sin_l[m - 1, :] = 0.0
+    cos = np.empty((m, n))
+    sin = np.empty((m, n))
+    cos[:, :half] = cos_l
+    sin[:, :half] = sin_l
+    cos[:, half:] = cos_l[:, 1 : n - half + 1][:, ::-1]
+    sin[:, half:] = -sin_l[:, 1 : n - half + 1][:, ::-1]
+    return np.concatenate([cos, sin], axis=0) / n
 
 
 def split_backward_matrix(n: int) -> np.ndarray:
     """(n x 2m) real synthesis matrix B with ``v = B @ [Re(c); Im(c)]``
     (inverse of :func:`split_forward_matrix`; mode weights 1/2/1 for
-    k = 0 / interior / Nyquist-of-even-n)."""
+    k = 0 / interior / Nyquist-of-even-n).  Bottom row half is
+    mirror-constructed (see :func:`split_forward_matrix`)."""
     m = n // 2 + 1
-    j = np.arange(n)[:, None]
+    half = n // 2 + 1
+    j = np.arange(half)[:, None]
     k = np.arange(m)[None, :]
     ang = 2.0 * np.pi * j * k / n
     w = np.full(m, 2.0)
     w[0] = 1.0
     if n % 2 == 0:
         w[-1] = 1.0
-    return np.concatenate([w * np.cos(ang), -w * np.sin(ang)], axis=1)
+    cos_t = w * np.cos(ang)
+    sin_t = -w * np.sin(ang)
+    if n % 2 == 0:
+        sin_t[:, m - 1] = 0.0  # Nyquist mode: sin(pi*j) = 0 exactly
+        sin_t[half - 1, :] = 0.0  # self-mirror row j = n/2: sin(pi*k) = 0
+    B = np.empty((n, 2 * m))
+    B[:half] = np.concatenate([cos_t, sin_t], axis=1)
+    B[half:] = np.concatenate([cos_t, -sin_t], axis=1)[1 : n - half + 1][::-1]
+    return B
+
+
+def dft_cos_matrix(n: int) -> np.ndarray:
+    """(n x n) matrix ``cos(2pi k j / n)`` with both the row and the column
+    mirror (k -> n-k, j -> n-j) exact by construction — the quarter-fold
+    (`circ_both`) structure ops/folded.py exploits."""
+    half = n // 2 + 1
+    j = np.arange(half)[:, None]
+    k = np.arange(half)[None, :]
+    q = np.cos(2.0 * np.pi * j * k / n)
+    top = np.empty((half, n))
+    top[:, :half] = q
+    top[:, half:] = q[:, 1 : n - half + 1][:, ::-1]
+    M = np.empty((n, n))
+    M[:half] = top
+    M[half:] = top[1 : n - half + 1][::-1]
+    return M
+
+
+def dft_sin_matrix(n: int) -> np.ndarray:
+    """(n x n) matrix ``sin(2pi k j / n)``, mirrors exact (antisymmetric in
+    both directions; see :func:`dft_cos_matrix`)."""
+    half = n // 2 + 1
+    j = np.arange(half)[:, None]
+    k = np.arange(half)[None, :]
+    q = np.sin(2.0 * np.pi * j * k / n)
+    if n % 2 == 0:
+        q[half - 1, :] = 0.0  # sin(pi*k) = 0 exactly (self-mirror row)
+        q[:, half - 1] = 0.0  # sin(pi*j) = 0 exactly (self-mirror column)
+    top = np.empty((half, n))
+    top[:, :half] = q
+    top[:, half:] = -q[:, 1 : n - half + 1][:, ::-1]
+    M = np.empty((n, n))
+    M[:half] = top
+    M[half:] = -top[1 : n - half + 1][::-1]
+    return M
 
 
 def diff_diag(k: np.ndarray, order: int, n: int, r2c: bool) -> np.ndarray:

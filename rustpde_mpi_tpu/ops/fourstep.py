@@ -1,0 +1,298 @@
+"""Four-step (Bailey) FFT factorizations as batched MXU GEMMs.
+
+The reference outsources its transforms to rustfft/rustdct — O(n log n)
+recursive FFTs (/root/reference/Cargo.toml:17 via funspace, SURVEY.md S2.2).
+A literal radix-2 FFT is the wrong shape for a TPU: log2(n) sequential
+stages of tiny butterflies starve the MXU.  The TPU-native equivalent is the
+*four-step* factorization n = n1*n2:
+
+    X[k2 + n2*k1] = sum_{j1} w1^{j1 k1} [ w^{j1 k2} sum_{j2} w2^{j2 k2}
+                                          x[j1 + n1*j2] ]
+
+i.e. (1) reshape, (2) a length-n2 DFT as one GEMM over all n1*batch lanes,
+(3) an elementwise twiddle, (4) a length-n1 DFT as one GEMM — O(n*(n1+n2))
+flops instead of the dense transform's O(n^2), with both stages still large
+MXU-friendly matrix products in *real* arithmetic (the axon TPU backend has
+no complex dtypes).  Real-input (r2c) transforms compute only the k2 half
+spectrum in stage 2 (Hermitian mirror is a slice+flip) and only k1 <=
+ceil(n1/2) in stage 4; real-*output* transforms (the DCT cores and the c2r
+synthesis) drop the imaginary accumulators of their final stage.
+
+The Chebyshev DCT-I rides the same core: the cosine kernel of size N+1 is
+the real part of the length-2N r2c DFT of the even extension, so both the
+analysis and the synthesis direction reduce to ``rfft_re`` plus diagonal
+pre/post scalings (ops/transforms.py keeps the FFT-path equivalents).
+
+Everything here is exact to reassociation; tests pin equality against the
+dense transform matrices at 1e-12 (f64).
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+import jax.numpy as jnp
+
+_MODE = os.environ.get("RUSTPDE_FOURSTEP", "auto")
+_MIN = int(os.environ.get("RUSTPDE_FOURSTEP_MIN", "512"))
+
+
+def enabled(n: int) -> bool:
+    """Whether the four-step path should replace the dense transform GEMM for
+    a length-n DFT.  ``RUSTPDE_FOURSTEP``: "auto" (default; engages at
+    n >= RUSTPDE_FOURSTEP_MIN=512 where the factored flops dominate the extra
+    dispatch), "1" (whenever factorable, incl. small sizes — used by tests),
+    "0" (never)."""
+    if _MODE == "0":
+        return False
+    if _MODE == "1":
+        return viable(n, 4)
+    return n >= _MIN and viable(n)
+
+
+def default_factors(n: int) -> tuple[int, int]:
+    """Split n = n1*n2 with n1 <= n2, n1 as close to sqrt(n) as divisibility
+    allows (balanced stages minimize total GEMM flops ~ n*(n1+n2))."""
+    n1 = int(np.sqrt(n))
+    while n1 > 1 and n % n1 != 0:
+        n1 -= 1
+    return n1, n // n1
+
+
+def viable(n: int, min_factor: int = 8) -> bool:
+    """A four-step plan only pays off when both stages are real GEMMs."""
+    n1, _ = default_factors(n)
+    return n1 >= min_factor
+
+
+class RfftPlan:
+    """Real-input forward DFT of length n (batched along the other dims).
+
+    ``split(x)``  -> (2m, ...) stacked [Re; Im] of the *unnormalized* rfft,
+    ``re(x)``     -> (m, ...) real part only (the DCT-I core),
+    m = n//2 + 1.  ``x`` must already have the transform axis moved to 0.
+    """
+
+    def __init__(self, n: int, to_dev, n1: int | None = None):
+        self.n = n
+        if n1 is None:
+            n1, n2 = default_factors(n)
+        else:
+            n2 = n // n1
+        assert n1 * n2 == n
+        self.n1, self.n2 = n1, n2
+        self.m = n // 2 + 1
+        m2 = n2 // 2 + 1
+        self.m2 = m2
+        h1 = n1 // 2 + 1
+        self.h1 = h1
+        j2 = np.arange(n2)[None, :]
+        k2 = np.arange(m2)[:, None]
+        ang2 = 2.0 * np.pi * k2 * j2 / n2
+        j1 = np.arange(n1)[None, :]
+        k1h = np.arange(h1)[:, None]
+        ang1 = 2.0 * np.pi * k1h * j1 / n1
+        k2f = np.arange(n2)[:, None]
+        tw = 2.0 * np.pi * k2f * j1 / n
+        self._c2 = to_dev(np.cos(ang2))  # (m2, n2)
+        self._s2 = to_dev(np.sin(ang2))
+        self._twc = to_dev(np.cos(tw))  # (n2, n1)
+        self._tws = to_dev(np.sin(tw))
+        self._c1 = to_dev(np.cos(ang1))  # (h1, n1)
+        self._s1 = to_dev(np.sin(ang1))
+
+    # -- stages ------------------------------------------------------------
+
+    def _stage12(self, x):
+        """x: (n, ...) real -> twiddled Z (n2, n1, ...) complex as (re, im)."""
+        n1, n2, m2 = self.n1, self.n2, self.m2
+        batch = x.shape[1:]
+        a = x.reshape((n2, n1) + batch)  # a[j2, j1] = x[j1 + n1*j2]
+        yre = jnp.tensordot(self._c2, a, axes=([1], [0]))  # (m2, n1, ...)
+        yim = -jnp.tensordot(self._s2, a, axes=([1], [0]))
+        # Hermitian mirror to the full k2 range: rows n2-k2 for k2=m2..n2-1
+        mir = slice(1, n2 - m2 + 1)
+        yre = jnp.concatenate([yre, jnp.flip(yre[mir], 0)], axis=0)
+        yim = jnp.concatenate([yim, -jnp.flip(yim[mir], 0)], axis=0)
+        shape = (n2, n1) + (1,) * len(batch)
+        twc = self._twc.reshape(shape)
+        tws = self._tws.reshape(shape)
+        # w^{j1 k2} = cos - i sin
+        zre = twc * yre + tws * yim
+        zim = twc * yim - tws * yre
+        return zre, zim
+
+    def _finalize(self, block):
+        """(n2, h1, ...) stage-4 output -> (m, ...) in k = k2 + n2*k1 order.
+
+        The k-gather is a pure transpose: block.T flattened C-order lists
+        k1*n2 + k2 ... no: transposing to (h1, n2) and flattening gives index
+        q*n2 + r at (q, r) = (k1, k2), which is exactly k.  Slice to m."""
+        out = jnp.moveaxis(block, 1, 0)  # (h1, n2, ...)
+        return out.reshape((self.h1 * self.n2,) + out.shape[2:])[: self.m]
+
+    def re(self, x):
+        """Re(rfft(x)) along axis 0, unnormalized."""
+        zre, zim = self._stage12(x)
+        # Re part of sum_j1 (cos - i sin)(2pi j1 k1/n1) * Z
+        blk = jnp.einsum("kj,cj...->ck...", self._c1, zre) + jnp.einsum(
+            "kj,cj...->ck...", self._s1, zim
+        )
+        return self._finalize(blk)
+
+    def split(self, x):
+        """[Re; Im] of rfft(x) along axis 0, unnormalized (2m rows)."""
+        zre, zim = self._stage12(x)
+        bre = jnp.einsum("kj,cj...->ck...", self._c1, zre) + jnp.einsum(
+            "kj,cj...->ck...", self._s1, zim
+        )
+        bim = jnp.einsum("kj,cj...->ck...", self._c1, zim) - jnp.einsum(
+            "kj,cj...->ck...", self._s1, zre
+        )
+        return jnp.concatenate([self._finalize(bre), self._finalize(bim)], axis=0)
+
+
+class IrfftPlan:
+    """Real-output inverse DFT: split spectrum [Re; Im] (2m rows, amplitude
+    convention ``c = rfft/n``-style is the *caller's* business — this class
+    computes ``v_j = Re sum_{k=0}^{n-1} chat_k e^{+2pi i jk/n}`` with chat the
+    Hermitian extension weighted 1/2/1 exactly like
+    ops/fourier.split_backward_matrix)."""
+
+    def __init__(self, n: int, to_dev, n1: int | None = None):
+        self.n = n
+        if n1 is None:
+            n1, n2 = default_factors(n)
+        else:
+            n2 = n // n1
+        assert n1 * n2 == n
+        self.n1, self.n2 = n1, n2
+        self.m = n // 2 + 1
+        j1 = np.arange(n1)[:, None]
+        k1 = np.arange(n1)[None, :]
+        ang1 = 2.0 * np.pi * j1 * k1 / n1
+        j2 = np.arange(n2)[:, None]
+        k2 = np.arange(n2)[None, :]
+        ang2 = 2.0 * np.pi * j2 * k2 / n2
+        tw = 2.0 * np.pi * np.arange(n1)[:, None] * np.arange(n2)[None, :] / n
+        self._c1 = to_dev(np.cos(ang1))  # (n1, n1) contract k1
+        self._s1 = to_dev(np.sin(ang1))
+        self._c2 = to_dev(np.cos(ang2))  # (n2, n2) contract k2
+        self._s2 = to_dev(np.sin(ang2))
+        self._twc = to_dev(np.cos(tw))  # (n1, n2)
+        self._tws = to_dev(np.sin(tw))
+
+    def apply(self, s):
+        """s: (2m, ...) split spectrum, transform axis already moved to 0."""
+        n, n1, n2, m = self.n, self.n1, self.n2, self.m
+        batch = s.shape[1:]
+        re, im = s[:m], s[m:]
+        # Hermitian extension chat[k], k=0..n-1 (interior modes twice)
+        mir = slice(1, n - m + 1)
+        cre = jnp.concatenate([re, jnp.flip(re[mir], 0)], axis=0)
+        cim = jnp.concatenate([im, -jnp.flip(im[mir], 0)], axis=0)
+        wre = cre.reshape((n1, n2) + batch)  # W[k1, k2] = chat[n2*k1 + k2]
+        wim = cim.reshape((n1, n2) + batch)
+        # stage 2: G[j1, k2] = sum_k1 (cos + i sin)(2pi j1 k1/n1) W[k1, k2]
+        gre = jnp.tensordot(self._c1, wre, axes=([1], [0])) - jnp.tensordot(
+            self._s1, wim, axes=([1], [0])
+        )
+        gim = jnp.tensordot(self._c1, wim, axes=([1], [0])) + jnp.tensordot(
+            self._s1, wre, axes=([1], [0])
+        )
+        # stage 3: twiddle w^{+j1 k2}
+        shape = (n1, n2) + (1,) * len(batch)
+        twc = self._twc.reshape(shape)
+        tws = self._tws.reshape(shape)
+        hre = twc * gre - tws * gim
+        him = twc * gim + tws * gre
+        # stage 4 (real output): v[j2, j1] = sum_k2 cos(2pi j2 k2/n2) Hre
+        #                                   - sin(...) Him
+        v = jnp.einsum("mk,jk...->mj...", self._c2, hre) - jnp.einsum(
+            "mk,jk...->mj...", self._s2, him
+        )
+        return v.reshape((n,) + batch)  # (j2, j1) flattens to j1 + n1*j2
+
+
+class C2cPlan:
+    """Complex-to-complex DFT on split re/im planes.
+
+    ``sign=-1`` is the forward convention (e^{-2pi i jk/n}), ``sign=+1`` the
+    inverse (no 1/n — normalization is the caller's).  Input and output are
+    ``(re, im)`` pairs with the transform axis moved to 0.
+    """
+
+    def __init__(self, n: int, to_dev, sign: float, n1: int | None = None):
+        self.n = n
+        self.sign = float(sign)
+        if n1 is None:
+            n1, n2 = default_factors(n)
+        else:
+            n2 = n // n1
+        assert n1 * n2 == n
+        self.n1, self.n2 = n1, n2
+        j2 = np.arange(n2)[None, :]
+        k2 = np.arange(n2)[:, None]
+        ang2 = 2.0 * np.pi * k2 * j2 / n2
+        j1 = np.arange(n1)[None, :]
+        k1 = np.arange(n1)[:, None]
+        ang1 = 2.0 * np.pi * k1 * j1 / n1
+        tw = 2.0 * np.pi * np.arange(n2)[:, None] * np.arange(n1)[None, :] / n
+        self._c2 = to_dev(np.cos(ang2))  # (n2, n2)
+        self._s2 = to_dev(np.sin(ang2))
+        self._c1 = to_dev(np.cos(ang1))  # (n1, n1)
+        self._s1 = to_dev(np.sin(ang1))
+        self._twc = to_dev(np.cos(tw))  # (n2, n1)
+        self._tws = to_dev(np.sin(tw))
+
+    def apply(self, xre, xim):
+        n1, n2, sg = self.n1, self.n2, self.sign
+        batch = xre.shape[1:]
+        are = xre.reshape((n2, n1) + batch)
+        aim = xim.reshape((n2, n1) + batch)
+        # stage 2: contract j2 with (cos + i*sg*sin)
+        yre = jnp.tensordot(self._c2, are, axes=([1], [0])) - sg * jnp.tensordot(
+            self._s2, aim, axes=([1], [0])
+        )
+        yim = jnp.tensordot(self._c2, aim, axes=([1], [0])) + sg * jnp.tensordot(
+            self._s2, are, axes=([1], [0])
+        )
+        # stage 3 twiddle
+        shape = (n2, n1) + (1,) * len(batch)
+        twc = self._twc.reshape(shape)
+        tws = sg * self._tws.reshape(shape)
+        zre = twc * yre - tws * yim
+        zim = twc * yim + tws * yre
+        # stage 4: contract j1
+        bre = jnp.einsum("kj,cj...->ck...", self._c1, zre) - sg * jnp.einsum(
+            "kj,cj...->ck...", self._s1, zim
+        )
+        bim = jnp.einsum("kj,cj...->ck...", self._c1, zim) + sg * jnp.einsum(
+            "kj,cj...->ck...", self._s1, zre
+        )
+        # (k2, k1) -> k = k2 + n2*k1: transpose then flatten
+        bre = jnp.moveaxis(bre, 1, 0).reshape((self.n,) + batch)
+        bim = jnp.moveaxis(bim, 1, 0).reshape((self.n,) + batch)
+        return bre, bim
+
+
+class Dct1Plan:
+    """Fast DCT-I cosine-kernel application of size n = N+1 (any N whose
+    doubling 2N factors well): ``out_k = sum_j colw_j x_j cos(pi j k / N)`` with
+    the natural even-extension column weights colw = [1, 2, ..., 2, 1] —
+    exactly ``Re(rfft(ext(x)))`` where ext is the length-2N even extension.
+
+    Both Chebyshev transform directions are diagonal scalings around this
+    core (ops/chebyshev.analysis_matrix / synthesis_matrix conventions)."""
+
+    def __init__(self, n: int, to_dev, n1: int | None = None):
+        self.n = n
+        self.N = n - 1
+        self._plan = RfftPlan(2 * self.N, to_dev, n1=n1)
+
+    def apply(self, x):
+        """x: (n, ...), transform axis already at 0 -> (n, ...)."""
+        ext = jnp.concatenate([x, jnp.flip(x[1:-1], 0)], axis=0)
+        return self._plan.re(ext)  # (N+1, ...) = (n, ...)

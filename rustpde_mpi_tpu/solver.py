@@ -61,42 +61,28 @@ def ingredients_for_hholtz(space: Space2, axis: int):
     return mass, lap, None
 
 
-def _sorted_real_eig(x: np.ndarray):
-    """Eigendecomposition ordered for the fast-diagonalisation GEMMs.
+def _checker_shift(m: np.ndarray) -> int | None:
+    """Shift s in {0, 1} such that ``m[i, j] == 0`` (exactly) whenever
+    ``(i + j + s)`` is odd; None if neither holds.  The pure-Chebyshev solver
+    ingredients are products of even-offset banded matrices, so their
+    checkerboard zeros are *exact* floating-point zeros — no tolerance."""
+    r, c = m.shape
+    i = np.arange(r)[:, None]
+    j = np.arange(c)[None, :]
+    for s in (0, 1):
+        if not np.any(m[(i + j + s) % 2 == 1]):
+            return s
+    return None
 
-    The pure-Chebyshev pencils are parity-preserving (checkerboard), so
-    their eigenvectors carry definite even/odd parity.  Ordering eigenpairs
-    with vector parity alternating along the eigen index — evens at even
-    positions, odds at odd, each block descending by eigenvalue — makes the
-    modal maps Q / Q^-1C^-1P themselves checkerboard, so the FoldedMatrix
-    wrapper halves those GEMMs too (ops/folded.py).  The singular mode of
-    pure-Neumann problems is the constant (even, largest-lam) vector and
-    still lands at index 0, preserving the reference's contract
-    (/root/reference/src/solver/utils.rs:88-95, poisson.rs:84-87).  Pencils
-    without parity structure (mixed-BC bases) keep the plain descending
-    sort."""
+
+def _real_eig_desc(x: np.ndarray):
+    """Real eigendecomposition sorted by descending eigenvalue."""
     lam, q = np.linalg.eig(x)
-    if np.abs(lam.imag).max() > 1e-8 * max(np.abs(lam.real).max(), 1.0):
+    if np.abs(lam.imag).max(initial=0.0) > 1e-8 * max(np.abs(lam.real).max(), 1.0):
         raise ValueError("tensor-solver eigenvalues are significantly complex")
     lam = lam.real
     q = q.real if np.iscomplexobj(q) else q
     order = np.argsort(lam)[::-1]
-
-    # eigenvector parity: support only on even or only on odd rows
-    scale = np.abs(q).max(axis=0)
-    odd_part = np.abs(q[1::2]).max(axis=0)
-    even_part = np.abs(q[0::2]).max(axis=0)
-    tol = 1e-8 * scale
-    is_even = odd_part <= tol
-    is_odd = even_part <= tol
-    m = lam.size
-    n_even_target = (m + 1) // 2
-    if is_even.sum() == n_even_target and is_odd.sum() == m - n_even_target:
-        evens = [i for i in order if is_even[i]]
-        odds = [i for i in order if is_odd[i]]
-        order = np.empty(m, dtype=int)
-        order[0::2] = evens
-        order[1::2] = odds
     return lam[order], q[:, order]
 
 
@@ -114,7 +100,35 @@ def _axis_modal_data(space: Space2, axis: int, ci: float, sign: float):
     if base.kind.is_periodic:
         return sign * ci * (-(base.wavenumbers**2)), None, None
     mat_c, mat_a, precond = ingredients_for_hholtz(space, axis)
-    lam, q = _sorted_real_eig(np.linalg.solve(mat_c, mat_a))
+    if (
+        _checker_shift(mat_c) == 0
+        and _checker_shift(mat_a) == 0
+        and _checker_shift(precond) == 0
+    ):
+        # Parity-blocked eigendecomposition: the pencil preserves parity, so
+        # solve the even and odd subproblems independently and assemble with
+        # eigen indices interleaved (evens at even positions).  The modal
+        # maps are then checkerboard with *exact* zeros — a full-matrix eig
+        # leaves O(1e-7)-relative off-parity noise at n >= 1025, which
+        # silently defeated fold detection (and the noise is itself error:
+        # the true eigenvectors have definite parity).
+        m = mat_c.shape[0]
+        n_cols = precond.shape[1]
+        lam = np.empty(m)
+        q = np.zeros((m, m))
+        fwd = np.zeros((m, n_cols))
+        for par in (0, 1):
+            sl = slice(par, None, 2)
+            c_b = mat_c[sl, sl]
+            lam_b, q_b = _real_eig_desc(np.linalg.solve(c_b, mat_a[sl, sl]))
+            fwd_b = np.linalg.solve(q_b, np.linalg.solve(c_b, precond[sl, sl]))
+            lam[sl] = lam_b
+            q[sl, sl] = q_b
+            fwd[sl, sl] = fwd_b
+        return sign * ci * lam, fwd, q
+    # non-parity-preserving pencils (mixed Dirichlet-Neumann base): plain
+    # descending eigen order, as in the reference (solver/utils.rs:88-95)
+    lam, q = _real_eig_desc(np.linalg.solve(mat_c, mat_a))
     fwd = np.linalg.solve(q, np.linalg.solve(mat_c, precond))
     return sign * ci * lam, fwd, q
 

@@ -32,18 +32,31 @@ def _load_native():
     if _lib is not None or _lib_tried:
         return _lib
     _lib_tried = True
-    if not os.path.exists(_LIB_PATH):
-        src = os.path.join(_TOOLS_DIR, "tracer.cpp")
+    src = os.path.join(_TOOLS_DIR, "tracer.cpp")
+    stale = (
+        os.path.exists(_LIB_PATH)
+        and os.path.exists(src)
+        and os.path.getmtime(src) > os.path.getmtime(_LIB_PATH)
+    )
+    if stale or not os.path.exists(_LIB_PATH):
         if not os.path.exists(src):
             return None
+        # build to a temp path + atomic rename: concurrent importers (MPI
+        # ranks, parallel pytest) must never dlopen a half-written .so
+        tmp = _LIB_PATH + f".build.{os.getpid()}"
         try:
             subprocess.run(
-                ["g++", "-O3", "-fPIC", "-shared", src, "-o", _LIB_PATH],
+                ["g++", "-O3", "-fPIC", "-shared", src, "-o", tmp],
                 check=True,
                 capture_output=True,
                 timeout=120,
             )
+            os.replace(tmp, _LIB_PATH)
         except (OSError, subprocess.SubprocessError):
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
             return None
     try:
         lib = ctypes.CDLL(_LIB_PATH)

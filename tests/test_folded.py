@@ -55,8 +55,10 @@ def test_transform_matrices_fold(base_fn, n):
 @pytest.mark.parametrize("n", [16, 17])
 def test_spectral_operators_fold_checkerboard(n):
     base = cheb_dirichlet(n)
-    # stencil (k, k+2 couplings) and gradient matrices are checkerboard
-    assert _check(base.stencil, "checker").flops_factor == 0.5
+    # the stencil's two diagonals run as shifted adds; the dense projection
+    # and gradient matrices fold checkerboard
+    s = _check(base.stencil, "banded")
+    assert s.flops_factor < 0.5
     _check(base.projection, "checker")
     _check(base.gradient_matrix(1), "checker")
     _check(base.gradient_matrix(2), "checker")
@@ -174,11 +176,12 @@ def test_circ_both_quarter_fold_on_dft_matrices(monkeypatch):
     sign -> quarter-flops fold."""
     from rustpde_mpi_tpu.ops import folded
 
+    from rustpde_mpi_tpu.ops import fourier as fou
+
     monkeypatch.setattr(folded, "_CIRC_MIN_DIM", 4)
     for n in (16, 17):
-        k = np.arange(n)[:, None] * np.arange(n)[None, :]
-        cos = _check(np.cos(2 * np.pi * k / n), "circ_both")
-        sin = _check(np.sin(2 * np.pi * k / n), "circ_both")
+        cos = _check(fou.dft_cos_matrix(n), "circ_both")
+        sin = _check(fou.dft_sin_matrix(n), "circ_both")
         assert cos.flops_factor == 0.25
         assert sin.flops_factor == 0.25
 
@@ -194,5 +197,19 @@ def test_circular_fold_size_gate():
     assert small.kind == "plain"
     big = FoldedMatrix(fou.split_forward_matrix(2 * gate), _dev)
     assert big.kind == "circ_analysis"
-    k = np.arange(gate)[:, None] * np.arange(gate)[None, :]
-    assert FoldedMatrix(np.cos(2 * np.pi * k / gate), _dev).kind == "circ_both"
+    assert FoldedMatrix(fou.dft_cos_matrix(gate), _dev).kind == "circ_both"
+
+
+def test_banded_apply_families():
+    """Exactly-banded operators (stencils, B2 quasi-inverse, restricted eye)
+    run as shifted adds, matching the dense product to machine epsilon."""
+    for mat in (
+        chb.stencil_dirichlet(33),
+        chb.stencil_neumann(32),
+        chb.stencil_dirichlet_neumann(33),
+        chb.quasi_inverse_b2(32),
+        chb.restricted_eye(33),
+        chb.restricted_eye(32) @ chb.quasi_inverse_b2(32),
+    ):
+        fm = _check(mat, "banded", atol=1e-13)
+        assert fm.flops_factor < 0.25

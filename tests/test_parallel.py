@@ -150,3 +150,35 @@ def test_sharded_lnse_matches_serial():
     np.testing.assert_allclose(
         np.asarray(sharded.state.temp), np.asarray(serial.state.temp), atol=1e-11
     )
+
+
+def test_sharded_navier_with_fast_transforms():
+    """The four-step transform + cumsum-derivative paths must shard cleanly
+    under the pencil mesh (the flagship grids sit above the auto gates, so
+    dryrun_multichip exercises exactly this combination)."""
+    from rustpde_mpi_tpu import bases
+    from rustpde_mpi_tpu.ops import fourstep
+
+    mode, fderiv = fourstep._MODE, bases._FAST_DERIV
+    fourstep._MODE = "1"
+    bases._FAST_DERIV = "1"
+    try:
+
+        def build(mesh):
+            model = Navier2D(
+                33, 32, 1e4, 1.0, 0.01, 1.0, "rbc", periodic=False, mesh=mesh
+            )
+            model.set_velocity(0.1, 1.0, 1.0)
+            model.set_temperature(0.1, 1.0, 1.0)
+            return model
+
+        serial = build(None)
+        sharded = build(make_mesh())
+        serial.update_n(5)
+        sharded.update_n(5)
+        np.testing.assert_allclose(
+            np.asarray(sharded.state.temp), np.asarray(serial.state.temp), atol=1e-12
+        )
+    finally:
+        fourstep._MODE = mode
+        bases._FAST_DERIV = fderiv

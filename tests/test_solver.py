@@ -218,3 +218,24 @@ def test_poisson_with_scale():
     sol = solver.solve(space.to_ortho(fhat))
     out = np.asarray(space.backward(sol))
     np.testing.assert_allclose(out, u, atol=1e-9)
+
+
+def test_modal_maps_exactly_checkerboard():
+    """The parity-blocked eigendecomposition must produce modal maps whose
+    checkerboard zeros are exact (a full-matrix eig leaves ~1e-7-relative
+    off-parity noise at n >= 1025, silently defeating fold detection)."""
+    import jax.numpy as jnp
+
+    from rustpde_mpi_tpu.bases import Space2, cheb_dirichlet, cheb_neumann
+    from rustpde_mpi_tpu.ops.folded import FoldedMatrix
+    from rustpde_mpi_tpu.solver import _axis_modal_data
+
+    for ctor in (cheb_dirichlet, cheb_neumann):
+        space = Space2(ctor(65), ctor(65))
+        _, fwd, bwd = _axis_modal_data(space, 0, 1.0, 1.0)
+        for mat in (fwd, bwd):
+            r, c = mat.shape
+            i = np.arange(r)[:, None]
+            j = np.arange(c)[None, :]
+            assert not np.any(mat[(i + j) % 2 == 1])
+            assert FoldedMatrix(mat, jnp.asarray).kind == "checker"
