@@ -44,13 +44,14 @@ def find_base_field(nx, ny, dt, ra, pr, aspect, max_time):
 
 def main() -> int:
     quick = "--quick" in sys.argv
-    nx, ny = (24, 21) if quick else (128, 57)
+    tiny = "--tiny" in sys.argv  # CI smoke tier
+    nx, ny = (12, 11) if tiny else (24, 21) if quick else (128, 57)
     ra, pr, aspect = 1e5, 1.0, 1.0
     dt = 0.02
-    base_time = 20.0 if quick else 300.0
-    max_iter = 3 if quick else 30
-    horizons = [5.0] if quick else np.linspace(5.0, 50.0, 5)
-    energies = [1e-4] if quick else np.logspace(10.0, 0.0, 7) / 1e10
+    base_time = 4.0 if tiny else 20.0 if quick else 300.0
+    max_iter = 1 if tiny else 3 if quick else 30
+    horizons = [2.0] if tiny else [5.0] if quick else np.linspace(5.0, 50.0, 5)
+    energies = [1e-4] if (tiny or quick) else np.logspace(10.0, 0.0, 7) / 1e10
     alpha_0 = 1.0
     beta1 = beta2 = 0.5
 

@@ -27,6 +27,8 @@ def norm(arrs):
 def main() -> int:
     quick = "--quick" in sys.argv
     # reference config: (18,13), ra=3e3, pr=0.1, dt=0.01, t=10
+    # (no tinier tier: below this size/horizon the continuous-adjoint
+    # approximation legitimately misses the gate)
     nx, ny = (10, 9) if quick else (18, 13)
     max_time = 1.0 if quick else 10.0
     ra, pr, dt = 3e3, 0.1, 0.01

@@ -65,19 +65,23 @@ class BaseKind(enum.Enum):
 
 
 _FAST_DERIV = os.environ.get("RUSTPDE_FAST_DERIV", "auto")
-_FAST_DERIV_MIN = int(os.environ.get("RUSTPDE_FAST_DERIV_MIN", "512"))
+_FAST_DERIV_MIN = int(os.environ.get("RUSTPDE_FAST_DERIV_MIN", "2048"))
 
 
 def _fast_deriv_enabled(n: int) -> bool:
     """Chebyshev derivatives via the parity-cumsum recurrence
     (ops/transforms.cheb_derivative) instead of dense triangular GEMMs.
-    ``RUSTPDE_FAST_DERIV``: "auto" (default; engages at n >= 512 where the
-    GEMM flops dominate dispatch), "1" (always), "0" (never)."""
+    ``RUSTPDE_FAST_DERIV``: "auto" (default), "1" (always), "0" (never).
+    Auto is measured on the v5e (scripts/profile_step.py + /tmp A/B runs,
+    round 3): f32 cumsum 0.22 vs GEMM 0.46 ms at 2049 but 0.11 vs 0.07 at
+    1025 (dispatch/bandwidth bound), and in *emulated f64* the cumsum's scan
+    ops are 2-5x slower than the MXU GEMM at every tested size — so the
+    recurrence engages only for f32 at n >= 2048."""
     if _FAST_DERIV == "0":
         return False
     if _FAST_DERIV == "1":
         return True
-    return n >= _FAST_DERIV_MIN
+    return n >= _FAST_DERIV_MIN and not config.X64
 
 
 def _dev(mat: np.ndarray):
@@ -257,7 +261,7 @@ class Base:
     @cached_property
     def _dct_plan(self):
         N = self.n - 1
-        if N < 2 or not fourstep.enabled(2 * N):
+        if N < 2 or not fourstep.enabled(2 * N, "dct"):
             return None
         return fourstep.Dct1Plan(self.n, _dev)
 
@@ -423,13 +427,13 @@ class SplitFourierBase(Base):
 
     @cached_property
     def _rfft_plan(self):
-        if not fourstep.enabled(self.n):
+        if not fourstep.enabled(self.n, "dft"):
             return None
         return fourstep.RfftPlan(self.n, _dev)
 
     @cached_property
     def _irfft_plan(self):
-        if not fourstep.enabled(self.n):
+        if not fourstep.enabled(self.n, "dft"):
             return None
         return fourstep.IrfftPlan(self.n, _dev)
 
@@ -892,17 +896,17 @@ class BiPeriodicSpace2:
     # four-step plans (ops/fourstep.py); None below the size gate
     @cached_property
     def _y_rfft_plan(self):
-        return fourstep.RfftPlan(self.ny, _dev) if fourstep.enabled(self.ny) else None
+        return fourstep.RfftPlan(self.ny, _dev) if fourstep.enabled(self.ny, "dft") else None
 
     @cached_property
     def _y_irfft_plan(self):
-        return fourstep.IrfftPlan(self.ny, _dev) if fourstep.enabled(self.ny) else None
+        return fourstep.IrfftPlan(self.ny, _dev) if fourstep.enabled(self.ny, "dft") else None
 
     @cached_property
     def _x_c2c_fwd(self):
         return (
             fourstep.C2cPlan(self.nx, _dev, sign=-1.0)
-            if fourstep.enabled(self.nx)
+            if fourstep.enabled(self.nx, "c2c")
             else None
         )
 
@@ -910,7 +914,7 @@ class BiPeriodicSpace2:
     def _x_c2c_bwd(self):
         return (
             fourstep.C2cPlan(self.nx, _dev, sign=+1.0)
-            if fourstep.enabled(self.nx)
+            if fourstep.enabled(self.nx, "c2c")
             else None
         )
 

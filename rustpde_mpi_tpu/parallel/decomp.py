@@ -15,10 +15,11 @@ Pencil convention (reference field_mpi.rs:71-88):
 * **y-pencil**: axis 0 (x) distributed, axis 1 contiguous — physical data.
 * **x-pencil**: axis 1 (y) distributed, axis 0 contiguous — spectral data.
 
-The explicit transposes require the distributed extent to divide the mesh
-size (all_to_all exchanges equal tiles); the GSPMD constraint path in the
-models handles arbitrary (odd) extents via padding and remains the execution
-path for the physics.
+The explicit transposes accept arbitrary (odd) extents — the equal-tile
+all_to_all runs on a zero-padded shape and the pad is sliced away — so the
+MPI-parity surface expresses the production grids (129/1025/2049) just like
+funspace's transpose_x_to_y.  The GSPMD constraint path in the models
+remains the execution path for the physics.
 """
 
 from __future__ import annotations
@@ -104,14 +105,18 @@ class Decomp2d:
 
     # -- explicit repartitions ----------------------------------------------
 
-    def _check_divisible(self, axis: int) -> None:
-        n = self.global_shape[axis]
-        if n % self.nprocs:
-            raise ValueError(
-                f"explicit transpose needs axis {axis} extent {n} divisible "
-                f"by {self.nprocs} ranks (the GSPMD constraint path in "
-                "parallel/mesh.py handles uneven extents)"
-            )
+    def _pad(self, arr):
+        """Zero-pad both extents up to the next mesh multiple so the tiled
+        all_to_all exchanges equal blocks; the flagship grids are odd
+        (129/1025/2049 — funspace's transpose_x_to_y takes any extent,
+        SURVEY.md S2.2, and so does this).  The pad rows/cols ride the
+        collective and are sliced away by the caller."""
+        n0, n1 = self.global_shape
+        p0 = (-n0) % self.nprocs
+        p1 = (-n1) % self.nprocs
+        if p0 or p1:
+            arr = jnp.pad(arr, ((0, p0), (0, p1)))
+        return arr
 
     @staticmethod
     def transpose_x_to_y_local(block):
@@ -126,27 +131,26 @@ class Decomp2d:
         return jax.lax.all_to_all(block, AXIS, split_axis=1, concat_axis=0, tiled=True)
 
     def transpose_x_to_y(self, arr):
-        """Global-view repartition: axis-1-sharded -> axis-0-sharded."""
-        self._check_divisible(0)
-        self._check_divisible(1)
+        """Global-view repartition: axis-1-sharded -> axis-0-sharded.
+        Any extents (pad-and-slice around the equal-tile all_to_all)."""
+        n0, n1 = self.global_shape
         fn = _smap(
             self.transpose_x_to_y_local,
             self.mesh,
             in_specs=PartitionSpec(*SPEC),
             out_specs=PartitionSpec(*PHYS),
         )
-        return fn(arr)
+        return fn(self._pad(arr))[:n0, :n1]
 
     def transpose_y_to_x(self, arr):
-        self._check_divisible(0)
-        self._check_divisible(1)
+        n0, n1 = self.global_shape
         fn = _smap(
             self.transpose_y_to_x_local,
             self.mesh,
             in_specs=PartitionSpec(*PHYS),
             out_specs=PartitionSpec(*SPEC),
         )
-        return fn(arr)
+        return fn(self._pad(arr))[:n0, :n1]
 
     # -- placement helpers ---------------------------------------------------
 

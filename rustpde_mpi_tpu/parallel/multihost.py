@@ -127,21 +127,28 @@ def global_array(host_local: np.ndarray, sharding) -> jax.Array:
     )
 
 
-def host_local_array(arr: jax.Array) -> np.ndarray:
+def host_local_array(arr: jax.Array, spec: tuple | None = None) -> np.ndarray:
     """This host's slab of a global array (gather analog for per-host IO);
-    the full array on a single host.  Multi-host conversion needs the mesh,
-    so the array must carry a NamedSharding (anything placed through
-    global_array / the pencil mesh does)."""
+    the full array on a single host.
+
+    The conversion needs a mesh+spec.  Arrays coming out of jitted steps
+    carry an inferred GSPMDSharding (no mesh attached), so such arrays are
+    first re-placed onto the canonical pencil mesh with ``spec`` (default:
+    the spectral x-pencil layout every model state uses) — a same-device
+    resharding, metadata-only when the layouts already agree."""
     if jax.process_count() == 1:
         return np.asarray(arr)
     from jax.experimental import multihost_utils
 
+    from .mesh import SPEC, make_mesh
+
     if not isinstance(arr.sharding, jax.sharding.NamedSharding):
-        raise TypeError(
-            "host_local_array on a multi-host run needs a NamedSharding-"
-            f"placed array, got {type(arr.sharding).__name__}; place it via "
-            "global_array(...) or a mesh-sharded computation first"
+        named = jax.sharding.NamedSharding(
+            make_mesh(), jax.sharding.PartitionSpec(*(SPEC if spec is None else spec))
         )
+        # jit-resharding rather than device_put: GSPMD pads non-divisible
+        # dims (the odd spectral grid sizes), eager placement rejects them
+        arr = jax.jit(lambda a: a, out_shardings=named)(arr)
     return multihost_utils.global_array_to_host_local_array(
         arr, arr.sharding.mesh, arr.sharding.spec
     )

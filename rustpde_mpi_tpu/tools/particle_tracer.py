@@ -57,7 +57,10 @@ def _load_native():
                 os.unlink(tmp)
             except OSError:
                 pass
-            return None
+            if not os.path.exists(_LIB_PATH):
+                return None
+            # rebuild failed (e.g. no g++) but a previously-built library
+            # exists: keep using it rather than silently dropping to numpy
     try:
         lib = ctypes.CDLL(_LIB_PATH)
     except OSError:

@@ -117,7 +117,9 @@ def test_hand_adjoint_gradient_agreement(cls):
     (examples/navier_lnse_test_gradient.rs, rel-tol 0.3): the hand adjoint is
     a continuous-adjoint approximation; against the *exact* discrete gradient
     its error is config/seed dependent (measured 0.35-0.50 here, flat in dt),
-    so the gate is 0.6 with the direction check as the real assertion."""
+    so the gate is 0.6 with the direction check as the real assertion.  On
+    the reference's own matched config and tolerance the hand adjoint passes
+    0.3 — see test_reference_gradient_protocol_rel03."""
     model = _lnse(cls=cls)
     ic = model.state
     val_a, g_auto = model.grad_autodiff(1.0, 0.5, 0.5)
@@ -188,3 +190,26 @@ def test_meanfields_read_from_dns_snapshot(tmp_path):
     np.testing.assert_allclose(u, nav.get_field("velx"), atol=1e-12)
     np.testing.assert_allclose(v, nav.get_field("vely"), atol=1e-12)
     np.testing.assert_allclose(t, nav.get_field("temp"), atol=1e-12)
+
+
+def test_reference_gradient_protocol_rel03():
+    """The reference's exact validation protocol
+    (examples/navier_lnse_test_gradient.rs): periodic 18x13, Ra=3e3, Pr=0.1,
+    dt=0.01, init_random(1e-3), horizon 10.0, beta=(0.5,0.5), hand adjoint
+    vs FD of the same forward loop, rel tol 0.3.  Measured 0.169 here —
+    resolves the round-2 question about the looser 0.6 gate in
+    test_hand_adjoint_gradient_agreement: that gate compares a *different*
+    config against the exact discrete gradient; on the reference's own
+    protocol the hand adjoint meets the reference's own tolerance."""
+    model = Navier2DLnse.new_periodic(18, 13, 3e3, 0.1, 0.01, 1.0, "rbc")
+    model.init_random(1e-3)
+    ic = model.state
+    _, g_adj = model.grad_adjoint(10.0, 10.0, 0.5, 0.5)
+    model.state = ic
+    model.reset_time()
+    g_fd = model.grad_fd(10.0, 0.5, 0.5)
+    # grad_adjoint returns the descent direction (-dJ/du); FD measures +dJ/du
+    ga = [-np.asarray(g) for g in g_adj]
+    num = _norm([a - b for a, b in zip(ga, g_fd)])
+    rel = num / _norm(ga)
+    assert rel < 0.3, rel
