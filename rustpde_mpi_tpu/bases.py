@@ -654,20 +654,35 @@ class Space2:
             return "matmul"
         return self.method
 
+    # All transforms are polymorphic over extra *leading* batch dims: the
+    # tensor axes are the trailing two (models stack same-space fields and
+    # transform them in one batched GEMM; mesh constraints replicate the
+    # leading dims).
+
+    @staticmethod
+    def _batch_ax(arr) -> int:
+        """Index of the first tensor axis; loud failure below rank 2 (a 1-D
+        slice would otherwise transform one axis twice and return garbage)."""
+        if arr.ndim < 2:
+            raise ValueError(f"Space2 expects a (..., nx, ny) array, got rank {arr.ndim}")
+        return arr.ndim - 2
+
     def forward(self, v):
-        """Physical (n_x, n_y) -> spectral (m_x, m_y)."""
+        """Physical (..., n_x, n_y) -> spectral (..., m_x, m_y)."""
         from .parallel.mesh import PHYS, SPEC, constrain
 
-        out = self.bases[1].forward(constrain(v, PHYS), 1, self._axis_method(1))
-        out = self.bases[0].forward(constrain(out, SPEC), 0, self._axis_method(0))
+        ax = self._batch_ax(v)
+        out = self.bases[1].forward(constrain(v, PHYS), ax + 1, self._axis_method(1))
+        out = self.bases[0].forward(constrain(out, SPEC), ax, self._axis_method(0))
         return constrain(out, SPEC)
 
     def backward(self, vhat):
-        """Spectral (m_x, m_y) -> physical (n_x, n_y)."""
+        """Spectral (..., m_x, m_y) -> physical (..., n_x, n_y)."""
         from .parallel.mesh import PHYS, SPEC, constrain
 
-        out = self.bases[0].backward(constrain(vhat, SPEC), 0, self._axis_method(0))
-        out = self.bases[1].backward(constrain(out, PHYS), 1, self._axis_method(1))
+        ax = self._batch_ax(vhat)
+        out = self.bases[0].backward(constrain(vhat, SPEC), ax, self._axis_method(0))
+        out = self.bases[1].backward(constrain(out, PHYS), ax + 1, self._axis_method(1))
         return constrain(out, PHYS)
 
     def backward_ortho(self, c):
@@ -675,23 +690,29 @@ class Space2:
         reference's scratch ``field`` provides, /root/reference/src/navier_stokes/navier.rs:256)."""
         from .parallel.mesh import PHYS, SPEC, constrain
 
-        out = self.bases[0].backward_ortho(constrain(c, SPEC), 0, self._axis_method(0))
-        out = self.bases[1].backward_ortho(constrain(out, PHYS), 1, self._axis_method(1))
+        ax = self._batch_ax(c)
+        out = self.bases[0].backward_ortho(constrain(c, SPEC), ax, self._axis_method(0))
+        out = self.bases[1].backward_ortho(
+            constrain(out, PHYS), ax + 1, self._axis_method(1)
+        )
         return constrain(out, PHYS)
 
     def to_ortho(self, vhat):
-        out = self.bases[0].to_ortho(vhat, 0)
-        return self.bases[1].to_ortho(out, 1)
+        ax = self._batch_ax(vhat)
+        out = self.bases[0].to_ortho(vhat, ax)
+        return self.bases[1].to_ortho(out, ax + 1)
 
     def from_ortho(self, c):
-        out = self.bases[0].from_ortho(c, 0)
-        return self.bases[1].from_ortho(out, 1)
+        ax = self._batch_ax(c)
+        out = self.bases[0].from_ortho(c, ax)
+        return self.bases[1].from_ortho(out, ax + 1)
 
     def gradient(self, vhat, deriv, scale=None):
         """d^deriv[0]/dx d^deriv[1]/dy in ortho space; divides by
         scale^deriv like the reference (/root/reference/src/field.rs:127)."""
-        out = self.bases[0].gradient(vhat, deriv[0], 0)
-        out = self.bases[1].gradient(out, deriv[1], 1)
+        ax = self._batch_ax(vhat)
+        out = self.bases[0].gradient(vhat, deriv[0], ax)
+        out = self.bases[1].gradient(out, deriv[1], ax + 1)
         if scale is not None:
             factor = (scale[0] ** deriv[0]) * (scale[1] ** deriv[1])
             if factor != 1.0:

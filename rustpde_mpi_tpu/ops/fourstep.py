@@ -57,11 +57,20 @@ def enabled(n: int, kind: str = "dft") -> bool:
     a length-n DFT of the given kind ("dft" = r2c/c2r, "c2c", "dct" — n is
     the *DFT core* length, 2N for a size-(N+1) DCT-I).  ``RUSTPDE_FOURSTEP``:
     "auto" (default; per-kind measured thresholds above), "1" (whenever
-    factorable, incl. small sizes — used by tests), "0" (never)."""
+    factorable, incl. small sizes — used by tests), "0" (never).
+
+    Auto never engages in x64 mode: measured on the v5e in emulated f64 the
+    factored path loses at EVERY size (0.18-0.49x; the non-MXU twiddle/
+    mirror/stacking passes emulate far worse than the dense GEMM's extra
+    flops cost — same asymmetry as the cumsum derivative)."""
     if _MODE == "0":
         return False
     if _MODE == "1":
         return viable(n, 4)
+    from .. import config
+
+    if config.X64:
+        return False
     return n >= _MIN.get(kind, _MIN["dft"]) and viable(n)
 
 

@@ -66,10 +66,15 @@ class use_mesh:
         return False
 
 
-def sharding(spec: tuple) -> NamedSharding | None:
+def sharding(spec: tuple, ndim: int | None = None) -> NamedSharding | None:
+    """NamedSharding for ``spec``; when ``ndim`` exceeds the spec rank the
+    spec applies to the *trailing* dims (leading dims are replicated batch —
+    the stacked-field transforms in models/navier.py)."""
     mesh = active_mesh()
     if mesh is None:
         return None
+    if ndim is not None and ndim > len(spec):
+        spec = (None,) * (ndim - len(spec)) + tuple(spec)
     return NamedSharding(mesh, PartitionSpec(*spec))
 
 
@@ -77,8 +82,10 @@ def constrain(x, spec: tuple):
     """Pin ``x`` to a pencil layout inside a jitted computation; no-op without
     an active mesh.  This is the TPU equivalent of the reference's
     transpose_x_to_y/transpose_y_to_x calls — the collective itself is left
-    to XLA.  Outside a trace (eager setup code) it becomes a resharding."""
-    s = sharding(spec)
+    to XLA.  Outside a trace (eager setup code) it becomes a resharding.
+    Arrays with more dims than the spec treat the extra leading dims as
+    replicated batch."""
+    s = sharding(spec, np.ndim(x))
     if s is None:
         return x
     if _is_tracer(x):
@@ -112,15 +119,18 @@ def device_put(x, spec: tuple):
     out_shardings) rejects that in JAX; only in-jit sharding constraints pad.
     Non-divisible arrays are therefore left as-is here — the constraints
     inside the first jitted step distribute them."""
-    s = sharding(spec)
-    if s is None:
+    mesh = active_mesh()
+    if mesh is None:
         return x
     import jax.numpy as jnp
 
-    mesh = active_mesh()
     arr = jnp.asarray(x)
+    s = sharding(spec, arr.ndim)
+    # one source of truth for the leading-batch padding: read the padded
+    # spec back off the sharding itself
     divisible = all(
-        sp is None or arr.shape[i] % mesh.shape[sp] == 0 for i, sp in enumerate(spec)
+        sp is None or arr.shape[i] % mesh.shape[sp] == 0
+        for i, sp in enumerate(s.spec)
     )
     if divisible:
         return jax.device_put(arr, s)

@@ -193,7 +193,8 @@ class HholtzAdi:
             )
 
     def solve(self, rhs):
-        """rhs in ortho space -> solution in composite space.
+        """rhs in ortho space -> solution in composite space.  Extra leading
+        dims are batch (identical-operator fields solved in one dispatch).
 
         Under a parallel mesh the axis solves run on the pencil whose solve
         axis is local (the reference's HholtzAdiMpi transpose pattern,
@@ -201,15 +202,16 @@ class HholtzAdi:
         flips are sharding constraints, XLA inserts the all-to-alls."""
         from .parallel.mesh import PHYS, SPEC, constrain
 
+        ax = max(rhs.ndim - 2, 0)
         out = constrain(rhs, SPEC)
         if self.matvec[0] is not None:
-            out = self.matvec[0].apply(out, 0)
+            out = self.matvec[0].apply(out, ax)
         out = constrain(out, PHYS)
         if self.matvec[1] is not None:
-            out = self.matvec[1].apply(out, 1)
-        out = self.solvers[1].solve(out, 1)  # axis-1 recurrence, lanes = axis 0
+            out = self.matvec[1].apply(out, ax + 1)
+        out = self.solvers[1].solve(out, ax + 1)  # axis-1 recurrence
         out = constrain(out, SPEC)
-        out = self.solvers[0].solve(out, 0)  # axis-0 recurrence, lanes = axis 1
+        out = self.solvers[0].solve(out, ax)  # axis-0 recurrence
         return constrain(out, SPEC)
 
 
@@ -248,19 +250,21 @@ class TensorSolver:
         """Under a parallel mesh: GEMMs run on the x-pencil (axis 0 local),
         the per-eigenvalue banded solves on the y-pencil where the eigenvalue
         lanes (axis 0) are sharded — the reference's PoissonMpi lam-slicing
-        (/root/reference/src/solver_mpi/poisson.rs:139-187)."""
+        (/root/reference/src/solver_mpi/poisson.rs:139-187).  Extra leading
+        dims are batch (the per-eigenvalue factors broadcast against them)."""
         from .parallel.mesh import PHYS, SPEC, constrain
 
+        ax = max(rhs.ndim - 2, 0)
         out = constrain(rhs, SPEC)
         if self.matvec1 is not None:
-            out = self.matvec1.apply(constrain(out, PHYS), 1)
+            out = self.matvec1.apply(constrain(out, PHYS), ax + 1)
         out = constrain(out, SPEC)
         if self.fwd is not None:
-            out = self.fwd.apply(out, 0)
-        out = self.banded.solve(constrain(out, PHYS), 1)
+            out = self.fwd.apply(out, ax)
+        out = self.banded.solve(constrain(out, PHYS), ax + 1)
         out = constrain(out, SPEC)
         if self.bwd is not None:
-            out = self.bwd.apply(out, 0)
+            out = self.bwd.apply(out, ax)
         return constrain(out, SPEC)
 
 
@@ -296,22 +300,23 @@ class FastDiag:
         self.denom = jnp.asarray(denom, dtype=dt)
 
     def solve(self, rhs):
-        """rhs in ortho space -> solution in composite space.  Pencil flips
-        sit between the axis-0 and axis-1 contractions."""
+        """rhs in ortho space -> solution in composite space (extra leading
+        dims are batch).  Pencil flips sit between the two contractions."""
         from .parallel.mesh import PHYS, SPEC, constrain
 
+        ax = max(rhs.ndim - 2, 0)
         out = constrain(rhs, SPEC)
         if self.fwd[0] is not None:
-            out = self.fwd[0].apply(out, 0)
+            out = self.fwd[0].apply(out, ax)
         out = constrain(out, PHYS)
         if self.fwd[1] is not None:
-            out = self.fwd[1].apply(out, 1)
+            out = self.fwd[1].apply(out, ax + 1)
         out = out / self.denom.astype(out.dtype)
         if self.bwd[1] is not None:
-            out = self.bwd[1].apply(out, 1)
+            out = self.bwd[1].apply(out, ax + 1)
         out = constrain(out, SPEC)
         if self.bwd[0] is not None:
-            out = self.bwd[0].apply(out, 0)
+            out = self.bwd[0].apply(out, ax)
         return constrain(out, SPEC)
 
 
