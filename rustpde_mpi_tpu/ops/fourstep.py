@@ -39,16 +39,20 @@ import numpy as np
 import jax.numpy as jnp
 
 _MODE = os.environ.get("RUSTPDE_FOURSTEP", "auto")
-# Per-kind auto thresholds on the DFT length, measured on the v5e at batch
-# 1025 f32 (scripts/bench_transforms.py): below these the folded dense GEMM
-# wins (it is one well-shaped MXU op; the factored path's smaller-K stages +
-# twiddle/mirror passes only pay off once the dense O(n^2) bill is large
-# enough).  Measured ratios dense/fourstep: r2c 0.44x @1024 -> 2.1x @2048;
-# c2c 2.0x @1024; DCT core 0.81x @2048 -> 1.17x @4096.
+# Per-kind auto thresholds on the DFT length, measured on the v5e in f32
+# (scripts/bench_transforms.py + scripts/profile_step.py): below these the
+# folded dense GEMM wins (it is one well-shaped MXU op; the factored path's
+# smaller-K stages + twiddle/mirror passes only pay off once the dense
+# O(n^2) bill is large enough).  Measured ratios dense/fourstep: r2c 0.44x
+# @1024 -> 2.1x @2048; c2c 2.0x @1024, 2.9x @2048.  The DCT core never wins
+# at the production grid sizes: a batch-1025 microbench showed 1.2x at core
+# 4096, but in model context at 2049^2 (batch 2049) the dense pair runs
+# 1.13 ms vs 2.22 ms fourstep — so the DCT gate sits above every current
+# grid (re-measure before lowering).
 _MIN = {
     "dft": int(os.environ.get("RUSTPDE_FOURSTEP_MIN", "2048")),
     "c2c": int(os.environ.get("RUSTPDE_FOURSTEP_MIN_C2C", "1024")),
-    "dct": int(os.environ.get("RUSTPDE_FOURSTEP_MIN_DCT", "4096")),
+    "dct": int(os.environ.get("RUSTPDE_FOURSTEP_MIN_DCT", "8192")),
 }
 
 
