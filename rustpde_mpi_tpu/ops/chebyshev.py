@@ -65,19 +65,36 @@ def analysis_matrix(n: int) -> np.ndarray:
     """F such that ``uhat = F @ u`` (forward transform), exact inverse of
     :func:`synthesis_matrix` via DCT-I orthogonality (no matrix inversion).
     Right half mirror-constructed from the exact identity
-    ``F[k, N-j] = (-1)^k F[k, j]`` (see :func:`synthesis_matrix`)."""
+    ``F[k, N-j] = (-1)^k F[k, j]``; for even N the bottom row half is also
+    mirror-constructed from ``F[N-k, j] = (-1)^j F[k, j]`` (sigma and the
+    column weights are reflection-symmetric), so F carries BOTH reflection
+    structures bit-exactly and ops/folded.py can pick the cheaper
+    output-side (synthesis) fold for it."""
     N = n - 1
     half = N // 2 + 1
-    j = np.arange(half)[None, :]
-    k = np.arange(n)[:, None]
-    sgn = (-1.0) ** k
-    left = sgn * np.cos(np.pi * k * j / N)
     if N % 2 == 0:
-        # self-mirror column j = N/2 (see synthesis_matrix)
-        left[1::2, N // 2] = 0.0
-    F = np.empty((n, n))
-    F[:, :half] = left
-    F[:, half:] = (sgn * left[:, : n - half])[:, ::-1]
+        # quarter construction: rows k=0..N/2, cols j=0..N/2
+        k = np.arange(half)[:, None]
+        j = np.arange(half)[None, :]
+        sgnk = (-1.0) ** k
+        q = sgnk * np.cos(np.pi * k * j / N)
+        q[1::2, N // 2] = 0.0  # cos(pi*k/2) = 0 exactly for odd k
+        q[N // 2, 1::2] = 0.0  # cos(pi*j/2) = 0 exactly for odd j
+        top = np.empty((half, n))
+        top[:, :half] = q
+        top[:, half:] = (sgnk * q[:, : n - half])[:, ::-1]
+        F = np.empty((n, n))
+        F[:half] = top
+        sgnj = (-1.0) ** np.arange(n)[None, :]
+        F[half:] = (sgnj * top[: n - half])[::-1]
+    else:
+        j = np.arange(half)[None, :]
+        k = np.arange(n)[:, None]
+        sgn = (-1.0) ** k
+        left = sgn * np.cos(np.pi * k * j / N)
+        F = np.empty((n, n))
+        F[:, :half] = left
+        F[:, half:] = (sgn * left[:, : n - half])[:, ::-1]
     F[:, 1:-1] *= 2.0
     sigma = np.full(n, 1.0 / N)
     sigma[0] = sigma[-1] = 1.0 / (2.0 * N)
