@@ -10,7 +10,7 @@ Environment knobs:
 
     RUSTPDE_BENCH_CONFIGS  comma list / "all" (default) /
                            names: rbc129, periodic, poisson1025, rbc1025,
-                                  sh2048, rbc2049, rbc129_f64
+                                  rbc1025_f64, sh2048, rbc2049, rbc129_f64
     RUSTPDE_BENCH_STEPS    timed steps for the primary config (default 64)
     RUSTPDE_X64            1 for f64 parity mode (default 0 here)
 
@@ -43,6 +43,7 @@ DEFAULT_CONFIGS = [
     "periodic",
     "poisson1025",
     "rbc129_f64",
+    "rbc2049",
 ]
 
 
@@ -117,7 +118,9 @@ def main() -> int:
     # budgeted runs, the non-primary configs run least-recently-measured
     # first (per-entry 'seq' counters persisted in BENCH_FULL.json) — each
     # run picks up where the previous one was cut off.
-    budget = float(os.environ.get("RUSTPDE_BENCH_BUDGET_S", "420"))
+    # default sized so the primary + its f64 drift anchor (the two most
+    # expensive, pinned-first configs) both fit in one run
+    budget = float(os.environ.get("RUSTPDE_BENCH_BUDGET_S", "560"))
     bench_start = time.perf_counter()
 
     prev_results: dict = {}
