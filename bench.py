@@ -118,8 +118,9 @@ def main() -> int:
     # budgeted runs, the non-primary configs run least-recently-measured
     # first (per-entry 'seq' counters persisted in BENCH_FULL.json) — each
     # run picks up where the previous one was cut off.
-    # default sized so the primary + its f64 drift anchor (the two most
-    # expensive, pinned-first configs) both fit in one run
+    # default sized so the primary + its f64 drift anchor (the pinned-first
+    # pair, ~430 s measured together) both fit in one run; later configs
+    # start only if their last recorded wall time also fits
     budget = float(os.environ.get("RUSTPDE_BENCH_BUDGET_S", "560"))
     bench_start = time.perf_counter()
 
@@ -149,8 +150,17 @@ def main() -> int:
     skipped_for_budget: list[str] = []
     ok = True
     for name in names:
-        if time.perf_counter() - bench_start > budget and results:
-            print(f"# budget {budget:.0f}s exhausted; skipping {name}", file=sys.stderr)
+        # gate on the *estimated completion* (elapsed + this config's last
+        # recorded wall, default 120 s) so a run never starts a config that
+        # would overshoot the budget — an external driver timeout near the
+        # budget must still see the final JSON line
+        est = prev_results.get(name, {}).get("bench_wall_s", 120.0) or 120.0
+        if results and time.perf_counter() - bench_start + est > budget:
+            print(
+                f"# budget {budget:.0f}s would be exceeded (~{est:.0f}s for "
+                f"{name}); skipping",
+                file=sys.stderr,
+            )
             skipped_for_budget.append(name)
             continue
         t0 = time.perf_counter()
