@@ -228,6 +228,39 @@ class Base:
     # without the structure (mixed-BC bases) automatically run the plain GEMM
 
     @cached_property
+    def _sep_cache(self) -> dict:
+        """Device matrices for the parity-separated spectral layout
+        (ops/folded.py sep classes), cached per shared Base instance."""
+        return {}
+
+    def _sep_dev(self, key) -> FoldedMatrix:
+        """Sep-layout counterpart of the folded device matrices.  ``key``:
+        "fwd" | "bwd" | "stencil" | "proj" | "synthesis" | ("grad", order)."""
+        if not self.kind.is_chebyshev:
+            raise ValueError("sep layout is defined for Chebyshev-family bases only")
+        cache = self._sep_cache
+        if key not in cache:
+            if key == "fwd":
+                cache[key] = FoldedMatrix(
+                    self.projection @ chb.analysis_matrix(self.n), _dev, sep_out=True
+                )
+            elif key == "bwd":
+                cache[key] = FoldedMatrix(
+                    chb.synthesis_matrix(self.n) @ self.stencil, _dev, sep_in=True
+                )
+            elif key == "stencil":
+                cache[key] = FoldedMatrix(self.stencil, _dev, sep_in=True, sep_out=True)
+            elif key == "proj":
+                cache[key] = FoldedMatrix(self.projection, _dev, sep_in=True, sep_out=True)
+            elif key == "synthesis":
+                cache[key] = FoldedMatrix(chb.synthesis_matrix(self.n), _dev, sep_in=True)
+            else:
+                cache[key] = FoldedMatrix(
+                    self.gradient_matrix(key[1]), _dev, sep_in=True, sep_out=True
+                )
+        return cache[key]
+
+    @cached_property
     def _fwd_matrix(self) -> FoldedMatrix:
         if self.kind.is_chebyshev:
             return FoldedMatrix(self.projection @ chb.analysis_matrix(self.n), _dev)
@@ -305,9 +338,13 @@ class Base:
             )
         return self._grad_dev_cache[order]
 
-    def forward(self, v, axis: int, method: str = "fft"):
+    def forward(self, v, axis: int, method: str = "fft", sep: bool = False):
         """Physical -> (composite) spectral along ``axis``."""
         if self.kind.is_chebyshev:
+            if sep:
+                # sep layout: matmul only (the fast DCT/FFT cores produce the
+                # natural interleaved order)
+                return self._sep_dev("fwd").apply(v, axis)
             if method == "matmul":
                 if self.kind == BaseKind.CHEBYSHEV and self._dct_plan is not None:
                     # pure base: projection is the identity, so the whole
@@ -322,9 +359,11 @@ class Base:
             return tr.fourier_r2c_forward_fft(v, axis)
         return tr.fourier_c2c_forward_fft(v, axis)
 
-    def backward(self, vhat, axis: int, method: str = "fft"):
+    def backward(self, vhat, axis: int, method: str = "fft", sep: bool = False):
         """(Composite) spectral -> physical along ``axis``."""
         if self.kind.is_chebyshev:
+            if sep:
+                return self._sep_dev("bwd").apply(vhat, axis)
             if method == "matmul":
                 if self._dct_plan is not None:
                     # banded stencil + fast DCT synthesis — cheaper than the
@@ -336,10 +375,12 @@ class Base:
             return tr.fourier_r2c_backward_fft(vhat, axis, self.n)
         return tr.fourier_c2c_backward_fft(vhat, axis, self.n)
 
-    def backward_ortho(self, c, axis: int, method: str = "fft"):
+    def backward_ortho(self, c, axis: int, method: str = "fft", sep: bool = False):
         """Synthesize physical values from *orthogonal* coefficients along
         ``axis`` (no composite cast — gradients already live in ortho space)."""
         if self.kind.is_chebyshev:
+            if sep:
+                return self._sep_dev("synthesis").apply(c, axis)
             if method == "matmul":
                 if self._dct_plan is not None:
                     return self._fast_synthesis(c, axis)
@@ -349,21 +390,27 @@ class Base:
             return tr.fourier_r2c_backward_fft(c, axis, self.n)
         return tr.fourier_c2c_backward_fft(c, axis, self.n)
 
-    def to_ortho(self, vhat, axis: int):
+    def to_ortho(self, vhat, axis: int, sep: bool = False):
         if self.kind in (BaseKind.CHEBYSHEV, BaseKind.FOURIER_R2C, BaseKind.FOURIER_C2C):
             return vhat
+        if sep:
+            return self._sep_dev("stencil").apply(vhat, axis)
         return self._stencil_dev.apply(vhat, axis)
 
-    def from_ortho(self, c, axis: int):
+    def from_ortho(self, c, axis: int, sep: bool = False):
         if self.kind in (BaseKind.CHEBYSHEV, BaseKind.FOURIER_R2C, BaseKind.FOURIER_C2C):
             return c
+        if sep:
+            return self._sep_dev("proj").apply(c, axis)
         return self._proj_dev.apply(c, axis)
 
-    def gradient(self, vhat, order: int, axis: int):
+    def gradient(self, vhat, order: int, axis: int, sep: bool = False):
         """Composite spectral -> ortho-space derivative coefficients."""
         if order == 0:
-            return self.to_ortho(vhat, axis)
+            return self.to_ortho(vhat, axis, sep)
         if self.kind.is_chebyshev:
+            if sep:
+                return self._sep_dev(("grad", order)).apply(vhat, axis)
             if _fast_deriv_enabled(self.n):
                 # banded stencil + parity-cumsum recurrence: O(n) per lane
                 # instead of the dense upper-triangular D^order @ S GEMM
@@ -437,31 +484,33 @@ class SplitFourierBase(Base):
             return None
         return fourstep.IrfftPlan(self.n, _dev)
 
-    def forward(self, v, axis: int, method: str = "matmul"):
+    def forward(self, v, axis: int, method: str = "matmul", sep: bool = False):
         del method  # matmul is the only (and native) path
+        assert not sep, "sep layout is not defined for split-Fourier axes"
         if self._rfft_plan is not None:
             x = jnp.moveaxis(v, axis, 0)
             out = self._rfft_plan.split(x) / self.n
             return jnp.moveaxis(out, 0, axis)
         return self._fwd_dev.apply(v, axis)
 
-    def backward(self, vhat, axis: int, method: str = "matmul"):
+    def backward(self, vhat, axis: int, method: str = "matmul", sep: bool = False):
         del method
+        assert not sep, "sep layout is not defined for split-Fourier axes"
         if self._irfft_plan is not None:
             x = jnp.moveaxis(vhat, axis, 0)
             return jnp.moveaxis(self._irfft_plan.apply(x), 0, axis)
         return self._bwd_dev.apply(vhat, axis)
 
-    def backward_ortho(self, c, axis: int, method: str = "matmul"):
+    def backward_ortho(self, c, axis: int, method: str = "matmul", sep: bool = False):
         return self.backward(c, axis)
 
-    def to_ortho(self, vhat, axis: int):
+    def to_ortho(self, vhat, axis: int, sep: bool = False):
         return vhat
 
-    def from_ortho(self, c, axis: int):
+    def from_ortho(self, c, axis: int, sep: bool = False):
         return c
 
-    def gradient(self, vhat, order: int, axis: int):
+    def gradient(self, vhat, order: int, axis: int, sep: bool = False):
         """(ik)^order on the split blocks: i^order cycles through
         (1, i, -1, -i), i.e. (re, im) -> (re, im), (-k im, k re),
         -(re, im), (k im, -k re) times k^order."""
@@ -577,7 +626,9 @@ class Space2:
     path is unavailable and dense MXU transforms are used instead.
     """
 
-    def __init__(self, base_x: Base, base_y: Base, method: str | None = None):
+    def __init__(
+        self, base_x: Base, base_y: Base, method: str | None = None, sep=None
+    ):
         if base_y.kind.is_periodic and not base_x.kind.is_periodic:
             raise ValueError("periodic y-axis under non-periodic x is unsupported")
         self.bases = (base_x, base_y)
@@ -598,6 +649,25 @@ class Space2:
             # TPU (axon): no FFT and no complex dtypes -> dense MXU transforms.
             method = "matmul" if config.is_tpu_like() else "fft"
         self.method = method
+        # Parity-separated spectral layout (ops/folded.py): spectral axes are
+        # stored parity-permuted ([evens..., odds...]) so every structured
+        # operator runs on contiguous slices — no gathers/interleaves around
+        # the GEMMs.  ``sep``: None -> RUSTPDE_SEP env ("auto" default: on
+        # for all-Chebyshev matmul spaces, where the layout is defined and
+        # measured to win); True/False force.  Per-axis: only Chebyshev-
+        # family axes separate (split-Fourier axes keep their layout).
+        if sep is None:
+            env = os.environ.get("RUSTPDE_SEP", "auto")
+            if env == "auto":
+                sep = method == "matmul" and all(
+                    b.kind.is_chebyshev for b in self.bases
+                )
+            else:
+                sep = env == "1"
+        self.sep = (
+            bool(sep) and base_x.kind.is_chebyshev and method == "matmul",
+            bool(sep) and base_y.kind.is_chebyshev and method == "matmul",
+        )
 
     @property
     def base_x(self) -> Base:
@@ -672,8 +742,12 @@ class Space2:
         from .parallel.mesh import PHYS, SPEC, constrain
 
         ax = self._batch_ax(v)
-        out = self.bases[1].forward(constrain(v, PHYS), ax + 1, self._axis_method(1))
-        out = self.bases[0].forward(constrain(out, SPEC), ax, self._axis_method(0))
+        out = self.bases[1].forward(
+            constrain(v, PHYS), ax + 1, self._axis_method(1), sep=self.sep[1]
+        )
+        out = self.bases[0].forward(
+            constrain(out, SPEC), ax, self._axis_method(0), sep=self.sep[0]
+        )
         return constrain(out, SPEC)
 
     def backward(self, vhat):
@@ -681,8 +755,12 @@ class Space2:
         from .parallel.mesh import PHYS, SPEC, constrain
 
         ax = self._batch_ax(vhat)
-        out = self.bases[0].backward(constrain(vhat, SPEC), ax, self._axis_method(0))
-        out = self.bases[1].backward(constrain(out, PHYS), ax + 1, self._axis_method(1))
+        out = self.bases[0].backward(
+            constrain(vhat, SPEC), ax, self._axis_method(0), sep=self.sep[0]
+        )
+        out = self.bases[1].backward(
+            constrain(out, PHYS), ax + 1, self._axis_method(1), sep=self.sep[1]
+        )
         return constrain(out, PHYS)
 
     def backward_ortho(self, c):
@@ -691,28 +769,30 @@ class Space2:
         from .parallel.mesh import PHYS, SPEC, constrain
 
         ax = self._batch_ax(c)
-        out = self.bases[0].backward_ortho(constrain(c, SPEC), ax, self._axis_method(0))
+        out = self.bases[0].backward_ortho(
+            constrain(c, SPEC), ax, self._axis_method(0), sep=self.sep[0]
+        )
         out = self.bases[1].backward_ortho(
-            constrain(out, PHYS), ax + 1, self._axis_method(1)
+            constrain(out, PHYS), ax + 1, self._axis_method(1), sep=self.sep[1]
         )
         return constrain(out, PHYS)
 
     def to_ortho(self, vhat):
         ax = self._batch_ax(vhat)
-        out = self.bases[0].to_ortho(vhat, ax)
-        return self.bases[1].to_ortho(out, ax + 1)
+        out = self.bases[0].to_ortho(vhat, ax, sep=self.sep[0])
+        return self.bases[1].to_ortho(out, ax + 1, sep=self.sep[1])
 
     def from_ortho(self, c):
         ax = self._batch_ax(c)
-        out = self.bases[0].from_ortho(c, ax)
-        return self.bases[1].from_ortho(out, ax + 1)
+        out = self.bases[0].from_ortho(c, ax, sep=self.sep[0])
+        return self.bases[1].from_ortho(out, ax + 1, sep=self.sep[1])
 
     def gradient(self, vhat, deriv, scale=None):
         """d^deriv[0]/dx d^deriv[1]/dy in ortho space; divides by
         scale^deriv like the reference (/root/reference/src/field.rs:127)."""
         ax = self._batch_ax(vhat)
-        out = self.bases[0].gradient(vhat, deriv[0], ax)
-        out = self.bases[1].gradient(out, deriv[1], ax + 1)
+        out = self.bases[0].gradient(vhat, deriv[0], ax, sep=self.sep[0])
+        out = self.bases[1].gradient(out, deriv[1], ax + 1, sep=self.sep[1])
         if scale is not None:
             factor = (scale[0] ** deriv[0]) * (scale[1] ** deriv[1])
             if factor != 1.0:
@@ -725,9 +805,37 @@ class Space2:
         """2/3-rule mask over this space's spectral shape
         (/root/reference/src/navier_stokes/functions.rs:72-82); for a split
         Fourier axis the cutoff applies per complex mode, i.e. to the Re and
-        Im blocks alike (Base.dealias_cut)."""
+        Im blocks alike (Base.dealias_cut); sep axes get the mask in their
+        parity-permuted order."""
+        from .ops.folded import parity_perm
+
         cuts = [base.dealias_cut() for base in self.bases]
+        cuts = [
+            c[parity_perm(len(c))] if s else c for c, s in zip(cuts, self.sep)
+        ]
         return cuts[0][:, None] * cuts[1][None, :]
+
+    # -- sep-layout boundary (host side) -------------------------------------
+
+    def spectral_to_natural(self, vhat: np.ndarray) -> np.ndarray:
+        """Host copy of spectral coefficients in the natural index order
+        (identity for non-sep spaces) — the IO/parity boundary."""
+        from .ops.folded import parity_perm_inv
+
+        a = np.asarray(vhat)
+        for axis, s in enumerate(self.sep):
+            if s:
+                a = np.take(a, parity_perm_inv(a.shape[axis - 2]), axis=axis - 2)
+        return a
+
+    def spectral_from_natural(self, vhat: np.ndarray) -> np.ndarray:
+        from .ops.folded import parity_perm
+
+        a = np.asarray(vhat)
+        for axis, s in enumerate(self.sep):
+            if s:
+                a = np.take(a, parity_perm(a.shape[axis - 2]), axis=axis - 2)
+        return a
 
     def pin_zero_mode(self, vhat):
         """Zero the constant mode (the pressure singularity pin,
@@ -743,13 +851,17 @@ class Space2:
         for non-split spaces) — keeps checkpoint files layout-identical
         across backends."""
         if self.bases[0].kind.is_split:
-            return self.bases[0].to_complex(np.asarray(vhat), axis=0)
-        return np.asarray(vhat)
+            # a forced-sep y-axis still needs its unpermute (different axes,
+            # order-independent)
+            return self.bases[0].to_complex(self.spectral_to_natural(vhat), axis=0)
+        return self.spectral_to_natural(vhat)
 
     def vhat_from_complex(self, vhat_c: np.ndarray):
         if self.bases[0].kind.is_split:
-            return self.bases[0].from_complex(vhat_c, axis=0)
-        return vhat_c
+            return self.spectral_from_natural(
+                self.bases[0].from_complex(vhat_c, axis=0)
+            )
+        return self.spectral_from_natural(vhat_c)
 
 
 class Space1:

@@ -34,6 +34,33 @@ MATMUL_PRECISION = os.environ.get("RUSTPDE_MATMUL_PRECISION", "highest")
 jax.config.update("jax_default_matmul_precision", MATMUL_PRECISION)
 
 
+def enable_compilation_cache(path: str | None = None) -> str:
+    """Enable JAX's persistent compilation cache (works through the axon
+    relay: measured 39 s -> 9 s for the 1025^2 step compile, 67 s -> 10 s for
+    model build).  Call before the first jit dispatch; idempotent.
+
+    The env vars are also set so child processes (the f64 bench subprocess)
+    inherit the cache."""
+    if path is None:
+        path = os.environ.get(
+            "JAX_COMPILATION_CACHE_DIR",
+            os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), ".jax_cache"),
+        )
+    os.environ["JAX_COMPILATION_CACHE_DIR"] = path
+    os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES", "-1")
+    os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.5")
+    jax.config.update("jax_compilation_cache_dir", path)
+    jax.config.update(
+        "jax_persistent_cache_min_entry_size_bytes",
+        int(os.environ["JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES"]),
+    )
+    jax.config.update(
+        "jax_persistent_cache_min_compile_time_secs",
+        float(os.environ["JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS"]),
+    )
+    return path
+
+
 def real_dtype():
     """Default real dtype for device arrays."""
     return np.float64 if X64 else np.float32

@@ -115,9 +115,13 @@ def solid_porosity_interpolate(
     xs, ys = src.bases[0].points, src.bases[1].points
     out = []
     for values in solid_porosity(xs, ys, diameter, porosity):
-        vhat = np.asarray(src.forward(jnp.asarray(values)))
+        # truncate/zero-pad the LOWEST modes, i.e. in natural coefficient
+        # order — the spaces themselves may store spectral axes
+        # parity-permuted (sep layout) on the TPU path
+        vhat = src.spectral_to_natural(src.forward(jnp.asarray(values)))
         sh = (min(n, nx), min(n, ny))
         padded = np.zeros((nx, ny))
         padded[: sh[0], : sh[1]] = vhat[: sh[0], : sh[1]]
+        padded = dst.spectral_from_natural(padded)
         out.append(np.asarray(dst.backward(jnp.asarray(padded))))
     return out[0], out[1]

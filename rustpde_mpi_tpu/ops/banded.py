@@ -130,17 +130,41 @@ class DenseSolver:
     """Precomputed dense inverse; solve = one GEMM (MXU path for static
     well-conditioned systems).  Parity-preserving operators (every pure-
     Chebyshev Helmholtz pencil) have checkerboard-sparse inverses, which the
-    FoldedMatrix wrapper turns into two half-size GEMMs (ops/folded.py)."""
+    FoldedMatrix wrapper turns into two half-size GEMMs (ops/folded.py); under
+    ``sep=True`` the solve consumes/produces the parity-separated layout
+    (contiguous block GEMMs, no gathers)."""
 
-    def __init__(self, dense: np.ndarray, dtype=None):
+    def __init__(self, dense: np.ndarray, dtype=None, sep: bool = False):
         from .folded import FoldedMatrix
 
         dt = dtype or jnp.zeros(0).dtype
         inv = np.linalg.inv(np.asarray(dense, dtype=np.float64))
-        self._folded = FoldedMatrix(inv, lambda m: jnp.asarray(m, dtype=dt))
+        self._folded = FoldedMatrix(
+            inv, lambda m: jnp.asarray(m, dtype=dt), sep_in=sep, sep_out=sep
+        )
 
     def solve(self, b, axis: int):
         return self._folded.apply(b, axis)
+
+
+class SepWrapped:
+    """Adapter running a natural-order axis solver under a sep-layout axis:
+    permutes sep -> natural around the solve.  Costs two explicit gathers —
+    the correctness fallback for the sequential banded/Pallas paths (the TPU
+    path uses the sep-aware dense inverse, which needs none)."""
+
+    def __init__(self, solver, m: int):
+        from .folded import parity_perm, parity_perm_inv
+
+        self.solver = solver
+        self._perm = jnp.asarray(parity_perm(m))
+        self._inv = jnp.asarray(parity_perm_inv(m))
+
+    def solve(self, b, axis: int):
+        # sep position p holds natural index perm[p]: natural[i] = sep[inv[i]]
+        nat = jnp.take(b, self._inv, axis=axis)
+        out = self.solver.solve(nat, axis)
+        return jnp.take(out, self._perm, axis=axis)
 
 
 class DiagSolver:

@@ -46,6 +46,33 @@ def folding_enabled() -> bool:
     return os.environ.get("RUSTPDE_FOLDED", "1") != "0"
 
 
+# ---------------------------------------------------------------------------
+# Parity-separated ("sep") spectral layout
+# ---------------------------------------------------------------------------
+#
+# The folded applies above still pay strided gathers (``x[0::2]``), full-array
+# reverses and interleave scatters around every GEMM.  In the sep layout a
+# spectral axis of length m is stored parity-permuted — ``[0,2,4,...,1,3,...]``
+# (evens then odds) — so every parity-structured operator acts on *contiguous
+# slices* and reassembles with a concat (which XLA fuses into the output
+# buffers): zero data-movement passes.  The physical side keeps natural order
+# (elementwise products, masks, observables unchanged); analysis-type applies
+# produce sep output directly (concat instead of interleave), synthesis-type
+# consume it directly (slices instead of strided gathers).  This is the
+# layout-level completion of the reference's stride-2 structure
+# (/root/reference/src/solver/tdma.rs:49-82).
+
+
+def parity_perm(m: int) -> np.ndarray:
+    """Natural -> sep order: position p holds natural index perm[p]."""
+    return np.concatenate([np.arange(0, m, 2), np.arange(1, m, 2)])
+
+
+def parity_perm_inv(m: int) -> np.ndarray:
+    """Sep -> natural: position i holds sep position of natural index i."""
+    return np.argsort(parity_perm(m))
+
+
 def _move(a, axis):
     return jnp.moveaxis(a, axis, 0)
 
@@ -215,7 +242,137 @@ class _CheckerFold:
         return _unmove(_interleave(y_e, y_o, self.r), axis)
 
 
-def _detect(mat: np.ndarray):
+class _AnalysisSep(_AnalysisFold):
+    """Analysis-type apply with sep-layout output: the even/odd half-GEMM
+    results concatenate contiguously instead of interleaving."""
+
+    kind = "analysis_sep"
+
+    def apply(self, dev, a, axis: int):
+        m_e, m_o = dev
+        x = _move(a, axis)
+        h, n = self.h, self.n
+        xr = x[::-1]
+        u = x[:h] + xr[:h]
+        v = x[:h] - xr[:h]
+        if n % 2 == 1:
+            u = jnp.concatenate([u, x[h : h + 1]], axis=0)
+        y_e = jnp.tensordot(m_e, u, axes=([1], [0]))
+        y_o = jnp.tensordot(m_o, v, axes=([1], [0]))
+        return _unmove(jnp.concatenate([y_e, y_o], axis=0), axis)
+
+
+class _SynthesisSep(_SynthesisFold):
+    """Synthesis-type apply with sep-layout input: contiguous slices instead
+    of strided gathers."""
+
+    kind = "synthesis_sep"
+
+    def __init__(self, mat: np.ndarray):
+        super().__init__(mat)
+        self.ce = (mat.shape[1] + 1) // 2  # even-block size of the sep input
+
+    def apply(self, dev, a, axis: int):
+        m_e, m_o = dev
+        x = _move(a, axis)
+        A = jnp.tensordot(m_e, x[: self.ce], axes=([1], [0]))
+        B = jnp.tensordot(m_o, x[self.ce :], axes=([1], [0]))
+        top = A + B
+        floor = self.n // 2
+        bottom = (A - B)[:floor][::-1]
+        return _unmove(jnp.concatenate([top, bottom], axis=0), axis)
+
+
+def _detect_block(mat: np.ndarray):
+    """Banded-else-plain detection for the parity blocks of a sep operator."""
+    r, c = mat.shape
+    if min(r, c) >= 4:
+        scale = np.abs(mat).max() or 1.0
+        mask = np.abs(mat) > _ATOL * scale
+        if np.count_nonzero(mask) <= _MAX_BAND_OFFSETS * max(r, c):
+            rows, cols = np.nonzero(mask)
+            offs = np.unique(cols - rows)
+            if offs.size <= _MAX_BAND_OFFSETS and offs.size * 4 <= c:
+                kept = np.isin(np.arange(c)[None, :] - np.arange(r)[:, None], offs)
+                if np.abs(np.where(kept, 0.0, mat)).max() <= _ATOL * scale:
+                    return _BandedApply(mat, offs)
+    return _Plain(mat)
+
+
+class _SepBoth:
+    """Spectral->spectral operator between sep-layout axes: parity-preserving
+    (shift 0, e->e/o->o) or parity-flipping (shift 1, e->o/o->e) applies on
+    the contiguous parity blocks — no gathers, no interleaves; banded blocks
+    keep their shifted-add form with halved offsets."""
+
+    def __init__(self, mat: np.ndarray, shift: int):
+        r, c = mat.shape
+        self.r = r
+        self.ce = (c + 1) // 2
+        self.shift = shift
+        if shift == 0:
+            subs = (mat[0::2, 0::2], mat[1::2, 1::2])
+        else:  # even OUT rows couple odd IN cols and vice versa
+            subs = (mat[0::2, 1::2], mat[1::2, 0::2])
+        self.blocks = tuple(_detect_block(np.ascontiguousarray(s)) for s in subs)
+        tot = sum(b.flops_factor * s.size for b, s in zip(self.blocks, subs))
+        self.flops_factor = tot / (r * c) if r * c else 0.0
+        self.kind = (
+            f"sep_{'preserve' if shift == 0 else 'flip'}"
+            f"[{self.blocks[0].kind},{self.blocks[1].kind}]"
+        )
+
+    def device_parts(self, to_dev):
+        return tuple(b.device_parts(to_dev) for b in self.blocks)
+
+    def apply(self, dev, a, axis: int):
+        x = _move(a, axis)
+        x_e, x_o = x[: self.ce], x[self.ce :]
+        b_e, b_o = self.blocks
+        if self.shift == 0:
+            y_e = b_e.apply(dev[0], x_e, 0)
+            y_o = b_o.apply(dev[1], x_o, 0)
+        else:
+            y_e = b_e.apply(dev[0], x_o, 0)
+            y_o = b_o.apply(dev[1], x_e, 0)
+        return _unmove(jnp.concatenate([y_e, y_o], axis=0), axis)
+
+
+def _detect_sep(mat: np.ndarray, sep_in: bool, sep_out: bool):
+    """Impl selection for sep-layout sides.  Unstructured matrices absorb the
+    permutation into the dense operator (conjugation on the host — zero
+    runtime cost); structured ones get the gather-free block applies."""
+    if np.iscomplexobj(mat) or mat.ndim != 2:
+        raise ValueError("sep layout requires real 2-D operator matrices")
+    r, c = mat.shape
+    scale = np.abs(mat).max() or 1.0
+    structured = folding_enabled() and min(r, c) >= 4
+    if sep_in and sep_out:
+        if structured:
+            j = np.arange(r)[:, None]
+            k = np.arange(c)[None, :]
+            for shift in (0, 1):
+                zero_part = mat[(j + k + shift) % 2 == 1]
+                if np.abs(zero_part).max(initial=0.0) < _ATOL * scale:
+                    return _SepBoth(mat, shift)
+        return _Plain(mat[np.ix_(parity_perm(r), parity_perm(c))])
+    if sep_out:  # physical/natural input -> sep output (analysis position)
+        if structured:
+            sgn_r = (-1.0) ** np.arange(r)[:, None]
+            if np.abs(mat[:, ::-1] - sgn_r * mat).max() < _ATOL * scale:
+                return _AnalysisSep(mat)
+        return _Plain(mat[parity_perm(r), :])
+    # sep input -> physical/natural output (synthesis position)
+    if structured:
+        sgn_c = (-1.0) ** np.arange(c)[None, :]
+        if np.abs(mat[::-1, :] - sgn_c * mat).max() < _ATOL * scale:
+            return _SynthesisSep(mat)
+    return _Plain(mat[:, parity_perm(c)])
+
+
+def _detect(mat: np.ndarray, sep_in: bool = False, sep_out: bool = False):
+    if sep_in or sep_out:
+        return _detect_sep(np.asarray(mat), sep_in, sep_out)
     if not folding_enabled():
         return _Plain(mat)
     if np.iscomplexobj(mat) or mat.ndim != 2 or min(mat.shape) < 4:
@@ -230,7 +387,15 @@ def _detect(mat: np.ndarray):
         rows, cols = np.nonzero(mask)
         offs = np.unique(cols - rows)
         if offs.size <= _MAX_BAND_OFFSETS and offs.size * 4 <= c:
-            return _BandedApply(mat, offs)
+            # the banded apply DROPS everything off the kept diagonals, so
+            # it is only taken when the dropped entries are exact zeros —
+            # every current banded operator (stencils, B2, restricted eyes)
+            # is constructed that way.  A near-banded matrix with nonzero
+            # sub-tolerance off-band entries falls through to the lossless
+            # folds/dense applies instead of being silently truncated.
+            kept = np.isin(np.arange(c)[None, :] - np.arange(r)[:, None], offs)
+            if not np.any(np.where(kept, 0.0, mat)):
+                return _BandedApply(mat, offs)
     # synthesis-type first: pure transform matrices of even N carry BOTH
     # reflection structures (quarter-constructed, ops/chebyshev.py) and the
     # output-side fold is measured cheaper on TPU — its flip/concat touches
@@ -285,12 +450,13 @@ class FoldedMatrix:
     ``FoldedMatrix(host_matrix, to_dev).apply(a, axis)``.  ``to_dev`` is the
     host->device constant placement (bases._dev)."""
 
-    def __init__(self, mat: np.ndarray, to_dev):
-        self._impl = _detect(np.asarray(mat))
+    def __init__(self, mat: np.ndarray, to_dev, sep_in: bool = False, sep_out: bool = False):
+        self._impl = _detect(np.asarray(mat), sep_in, sep_out)
         self._dev = self._impl.device_parts(to_dev)
         # drop the host copies — apply() reads only the device parts and the
         # scalar shape metadata (at 2049^2 f64 a retained inverse is ~33 MB);
-        # recurse into wrapped impls (_CircBothFold holds an inner fold)
+        # recurse into wrapped impls (_CircBothFold holds an inner fold,
+        # _SepBoth holds per-parity blocks)
         stack = [self._impl]
         while stack:
             impl = stack.pop()
@@ -300,6 +466,7 @@ class FoldedMatrix:
             inner = getattr(impl, "_inner", None)
             if inner is not None:
                 stack.append(inner)
+            stack.extend(getattr(impl, "blocks", ()))
 
     @property
     def kind(self) -> str:

@@ -38,6 +38,12 @@ import numpy as np
 
 import jax.numpy as jnp
 
+# NOTE: _MODE/_MIN are re-read from the environment on every enabled() call
+# (they are cheap lookups), so tests/scripts may toggle RUSTPDE_FOURSTEP*
+# after import.  Plans already built into a Base/Space are NOT invalidated —
+# transform path selection is construction-time, like every other operator
+# choice in the package (rebuild the Space to change it).  config.X64 is
+# process-level (jax_enable_x64 at import) and cannot toggle mid-process.
 _MODE = os.environ.get("RUSTPDE_FOURSTEP", "auto")
 # Per-kind auto thresholds on the DFT length, measured on the v5e in f32
 # (scripts/bench_transforms.py + scripts/profile_step.py): below these the
@@ -67,15 +73,22 @@ def enabled(n: int, kind: str = "dft") -> bool:
     factored path loses at EVERY size (0.18-0.49x; the non-MXU twiddle/
     mirror/stacking passes emulate far worse than the dense GEMM's extra
     flops cost — same asymmetry as the cumsum derivative)."""
-    if _MODE == "0":
+    mode = os.environ.get("RUSTPDE_FOURSTEP", _MODE)
+    if mode == "0":
         return False
-    if _MODE == "1":
+    if mode == "1":
         return viable(n, 4)
     from .. import config
 
     if config.X64:
         return False
-    return n >= _MIN.get(kind, _MIN["dft"]) and viable(n)
+    env_min = {
+        "dft": os.environ.get("RUSTPDE_FOURSTEP_MIN"),
+        "c2c": os.environ.get("RUSTPDE_FOURSTEP_MIN_C2C"),
+        "dct": os.environ.get("RUSTPDE_FOURSTEP_MIN_DCT"),
+    }.get(kind)
+    lo = int(env_min) if env_min else _MIN.get(kind, _MIN["dft"])
+    return n >= lo and viable(n)
 
 
 def default_factors(n: int) -> tuple[int, int]:

@@ -135,19 +135,29 @@ def _axis_modal_data(space: Space2, axis: int, ci: float, sign: float):
 
 class _AxisSolver:
     """1-D solver for one axis: banded/dense/pallas (Chebyshev) or diagonal
-    (Fourier)."""
+    (Fourier).  ``sep``: the axis uses the parity-separated spectral layout —
+    the dense inverse handles it natively (block GEMMs, ops/folded.py); the
+    sequential banded/Pallas recurrences are wrapped with explicit
+    permutations (ops/banded.SepWrapped, the CPU correctness fallback)."""
 
-    def __init__(self, mat: np.ndarray, kind: BaseKind, method: str):
+    def __init__(self, mat: np.ndarray, kind: BaseKind, method: str, sep: bool = False):
+        from .ops.banded import SepWrapped
+
         if kind.is_periodic:
+            assert not sep, "sep layout is not defined for Fourier axes"
             self.solver = DiagSolver(np.diag(mat))
         elif method == "dense":
-            self.solver = DenseSolver(mat)
+            self.solver = DenseSolver(mat, sep=sep)
         elif method == "pallas":
             from .ops.pallas_banded import PallasBandedSolver
 
             self.solver = PallasBandedSolver(mat, _P, _Q)
+            if sep:
+                self.solver = SepWrapped(self.solver, mat.shape[-1])
         else:
             self.solver = BandedSolver(mat, _P, _Q)
+            if sep:
+                self.solver = SepWrapped(self.solver, mat.shape[-1])
 
     def solve(self, b, axis: int):
         return self.solver.solve(b, axis)
@@ -175,18 +185,22 @@ class HholtzAdi:
     def __init__(self, space: Space2, c, method: str | None = None):
         method = method or default_method()
         self.space = space
+        sep = getattr(space, "sep", (False, False))
         self.matvec = []
         self.solvers = []
         for axis, ci in enumerate(c):
             mat_a, mat_b, precond = ingredients_for_hholtz(space, axis)
             mat = mat_a - ci * mat_b
             kind = space.base_kind(axis)
-            self.solvers.append(_AxisSolver(mat, kind, method))
+            self.solvers.append(_AxisSolver(mat, kind, method, sep=sep[axis]))
             # the B2 precond is checkerboard parity-foldable like every
             # pure-Chebyshev operator (ops/folded.py) -> two half GEMMs
             self.matvec.append(
                 FoldedMatrix(
-                    precond, lambda m: jnp.asarray(m, dtype=config.real_dtype())
+                    precond,
+                    lambda m: jnp.asarray(m, dtype=config.real_dtype()),
+                    sep_in=sep[axis],
+                    sep_out=sep[axis],
                 )
                 if precond is not None
                 else None
@@ -202,7 +216,13 @@ class HholtzAdi:
         flips are sharding constraints, XLA inserts the all-to-alls."""
         from .parallel.mesh import PHYS, SPEC, constrain
 
-        ax = max(rhs.ndim - 2, 0)
+        if rhs.ndim < 2:
+            raise ValueError(
+                f"2-D tensor solver needs rhs.ndim >= 2, got {rhs.ndim} "
+                "(a rank-1 rhs would silently solve both axes over the same "
+                "axis; batch dims go in front)"
+            )
+        ax = rhs.ndim - 2
         out = constrain(rhs, SPEC)
         if self.matvec[0] is not None:
             out = self.matvec[0].apply(out, ax)
@@ -226,25 +246,48 @@ class TensorSolver:
     maps the axis-0 *ortho-space* rhs into eigenspace (preconditioner folded
     in), so no separate axis-0 matvec is applied."""
 
-    def __init__(self, modal0, a1, c1, precond1, alpha: float, fix_singular=False):
+    def __init__(
+        self, modal0, a1, c1, precond1, alpha: float, fix_singular=False,
+        sep=(False, False),
+    ):
+        from .ops.banded import SepWrapped
+        from .ops.folded import parity_perm
+
         dt = config.real_dtype()
         lam, fwd0, bwd0 = modal0
+        s0 = sep[0] and fwd0 is not None  # Fourier axes are never sep
         to_dev = lambda m: jnp.asarray(m, dtype=dt)  # noqa: E731
-        self.fwd = FoldedMatrix(fwd0, to_dev) if fwd0 is not None else None
-        self.bwd = FoldedMatrix(bwd0, to_dev) if bwd0 is not None else None
+        self.fwd = (
+            FoldedMatrix(fwd0, to_dev, sep_in=s0, sep_out=s0)
+            if fwd0 is not None
+            else None
+        )
+        self.bwd = (
+            FoldedMatrix(bwd0, to_dev, sep_in=s0, sep_out=s0)
+            if bwd0 is not None
+            else None
+        )
         if fix_singular and abs(lam[0]) < 1e-10:
             # pure-Neumann problems: nudge the zero mode so the banded
             # factorization exists (/root/reference/src/solver/poisson.rs:84-87)
             lam = lam.copy()
             lam -= 1e-10
+        if s0:
+            # eigenvalue lanes live on the sep-ordered axis 0
+            lam = lam[parity_perm(len(lam))]
         self.lam = lam
         self.alpha = alpha
         self.matvec1 = (
-            FoldedMatrix(precond1, to_dev) if precond1 is not None else None
+            FoldedMatrix(precond1, to_dev, sep_in=sep[1], sep_out=sep[1])
+            if precond1 is not None
+            else None
         )
         # (A_y + (lam_i + alpha) C_y) factored for every eigenvalue lane i
         mats = a1[None, :, :] + (lam[:, None, None] + alpha) * c1[None, :, :]
         self.banded = BandedSolver(mats, _P, _Q)
+        if sep[1]:
+            # the banded recurrence runs in natural axis-1 order
+            self.banded = SepWrapped(self.banded, a1.shape[-1])
 
     def solve(self, rhs):
         """Under a parallel mesh: GEMMs run on the x-pencil (axis 0 local),
@@ -254,7 +297,13 @@ class TensorSolver:
         dims are batch (the per-eigenvalue factors broadcast against them)."""
         from .parallel.mesh import PHYS, SPEC, constrain
 
-        ax = max(rhs.ndim - 2, 0)
+        if rhs.ndim < 2:
+            raise ValueError(
+                f"2-D tensor solver needs rhs.ndim >= 2, got {rhs.ndim} "
+                "(a rank-1 rhs would silently solve both axes over the same "
+                "axis; batch dims go in front)"
+            )
+        ax = rhs.ndim - 2
         out = constrain(rhs, SPEC)
         if self.matvec1 is not None:
             out = self.matvec1.apply(constrain(out, PHYS), ax + 1)
@@ -283,14 +332,25 @@ class FastDiag:
     identity and their eigenvalues are -k^2.
     """
 
-    def __init__(self, modal0, modal1, alpha: float, fix_singular=False):
+    def __init__(self, modal0, modal1, alpha: float, fix_singular=False, sep=(False, False)):
+        from .ops.folded import parity_perm
+
         dt = config.real_dtype()
         lams, self.fwd, self.bwd = [], [], []
         to_dev = lambda m: jnp.asarray(m, dtype=dt)  # noqa: E731
-        for lam, fwd, bwd in (modal0, modal1):
-            self.fwd.append(FoldedMatrix(fwd, to_dev) if fwd is not None else None)
-            self.bwd.append(FoldedMatrix(bwd, to_dev) if bwd is not None else None)
-            lams.append(lam)
+        for si, (lam, fwd, bwd) in zip(sep, (modal0, modal1)):
+            si = si and fwd is not None  # Fourier axes are never sep
+            self.fwd.append(
+                FoldedMatrix(fwd, to_dev, sep_in=si, sep_out=si)
+                if fwd is not None
+                else None
+            )
+            self.bwd.append(
+                FoldedMatrix(bwd, to_dev, sep_in=si, sep_out=si)
+                if bwd is not None
+                else None
+            )
+            lams.append(lam[parity_perm(len(lam))] if si else lam)
         if fix_singular and abs(lams[0][0]) < 1e-10:
             # pure-Neumann zero mode: same nudge as the reference
             # (/root/reference/src/solver/poisson.rs:84-87)
@@ -304,7 +364,13 @@ class FastDiag:
         dims are batch).  Pencil flips sit between the two contractions."""
         from .parallel.mesh import PHYS, SPEC, constrain
 
-        ax = max(rhs.ndim - 2, 0)
+        if rhs.ndim < 2:
+            raise ValueError(
+                f"2-D tensor solver needs rhs.ndim >= 2, got {rhs.ndim} "
+                "(a rank-1 rhs would silently solve both axes over the same "
+                "axis; batch dims go in front)"
+            )
+        ax = rhs.ndim - 2
         out = constrain(rhs, SPEC)
         if self.fwd[0] is not None:
             out = self.fwd[0].apply(out, ax)
@@ -338,10 +404,11 @@ class _TensorBased:
     ):
         method = method or ("fd" if config.is_tpu_like() else "banded")
         sign = -1.0 if negate_lap else 1.0
+        sep = getattr(space, "sep", (False, False))
         modal0 = _axis_modal_data(space, 0, c[0], sign)
         if method == "fd":
             modal1 = _axis_modal_data(space, 1, c[1], sign)
-            self._solver = FastDiag(modal0, modal1, alpha, fix_singular)
+            self._solver = FastDiag(modal0, modal1, alpha, fix_singular, sep=sep)
         else:
             # mat_c1 = preconditioned mass (pinv S, or I for Fourier),
             # mat_a1 = preconditioned laplacian (peye S, or diag(-k^2))
@@ -353,6 +420,7 @@ class _TensorBased:
                 precond1,
                 alpha,
                 fix_singular=fix_singular,
+                sep=sep,
             )
 
     def solve(self, rhs):

@@ -139,7 +139,10 @@ def read_field_vhat(h5, varname: str, space: Space2) -> np.ndarray:
             old_nx=old_nx,
             new_nx=space.shape_physical[0],
         )
-    return space.vhat_from_complex(data) if split else data
+    # vhat_from_complex is also the sep-layout boundary (Space2 stores
+    # Chebyshev spectral axes parity-permuted on the TPU path), so it must
+    # run for non-split spaces too — h5 files always hold natural order
+    return space.vhat_from_complex(data)
 
 
 def _model_coords(model):

@@ -11,6 +11,8 @@ import sys
 
 import pytest
 
+pytestmark = pytest.mark.slow  # heavyweight end-to-end tier (VERDICT r3 #8)
+
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 # example -> fast argv (tiny grids / --quick); every program must finish in

@@ -192,6 +192,7 @@ def test_meanfields_read_from_dns_snapshot(tmp_path):
     np.testing.assert_allclose(t, nav.get_field("temp"), atol=1e-12)
 
 
+@pytest.mark.slow
 def test_reference_gradient_protocol_rel03():
     """The reference's exact validation protocol
     (examples/navier_lnse_test_gradient.rs): periodic 18x13, Ra=3e3, Pr=0.1,

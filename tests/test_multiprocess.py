@@ -19,6 +19,8 @@ import sys
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow  # heavyweight end-to-end tier (VERDICT r3 #8)
+
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 _NPROC = 2
 

@@ -182,3 +182,83 @@ def test_sharded_navier_with_fast_transforms():
     finally:
         fourstep._MODE = mode
         bases._FAST_DERIV = fderiv
+
+
+# -- periodic (split Re/Im Fourier) configuration under the mesh -------------
+# The split spectral layout (doubled axis-0 blocks, bases.py SplitFourierBase)
+# interacts non-trivially with the pencil specs; these prove it correct under
+# GSPMD sharding (VERDICT r3 #4; reference behavior
+# /root/reference/src/navier_stokes_mpi/navier.rs:364-487 +
+# examples/navier_periodic_mpi.rs / navier_periodic_hc_mpi.rs).
+
+
+def _build_periodic(mesh, nx, ny, bc):
+    model = Navier2D(nx, ny, 1e4, 1.0, 5e-3, 1.0, bc, periodic=True, mesh=mesh)
+    model.set_velocity(0.1, 1.0, 1.0)
+    model.set_temperature(0.1, 1.0, 1.0)
+    return model
+
+
+@pytest.mark.parametrize("bc", ["rbc", "hc"])
+def test_sharded_periodic_matches_unsharded(bc):
+    serial = _build_periodic(None, 32, 17, bc)
+    sharded = _build_periodic(make_mesh(), 32, 17, bc)
+    serial.update_n(10)
+    sharded.update_n(10)
+    for attr in ("temp", "velx", "vely", "pres", "pseu"):
+        np.testing.assert_allclose(
+            np.asarray(getattr(sharded.state, attr)),
+            np.asarray(getattr(serial.state, attr)),
+            atol=1e-12,
+            err_msg=attr,
+        )
+    assert sharded.eval_nu() == pytest.approx(serial.eval_nu(), abs=1e-12)
+
+
+def test_sharded_periodic_nondivisible_nx():
+    # nx=20: neither the physical axis (20) nor the split spectral axis is
+    # divisible by 8 devices -> GSPMD pads; results must still match,
+    # including the pin of the zero mode's Im row (bases.py pin_zero_mode)
+    serial = _build_periodic(None, 20, 17, "rbc")
+    sharded = _build_periodic(make_mesh(), 20, 17, "rbc")
+    serial.update_n(8)
+    sharded.update_n(8)
+    for attr in ("temp", "velx", "vely", "pres", "pseu"):
+        np.testing.assert_allclose(
+            np.asarray(getattr(sharded.state, attr)),
+            np.asarray(getattr(serial.state, attr)),
+            atol=1e-12,
+            err_msg=attr,
+        )
+
+
+@pytest.mark.slow
+def test_sharded_production_shape_matches():
+    """Mesh-vs-serial at a production-class shape (>=257^2, f64) where
+    padding/uneven shards actually bite (VERDICT r3 #5): 257 = 8*32+1 on the
+    Chebyshev axes; the periodic config runs 256x257."""
+    cases = [
+        dict(nx=257, ny=257, periodic=False),
+        dict(nx=256, ny=257, periodic=True),
+    ]
+    for case in cases:
+        def build(mesh):
+            model = Navier2D(
+                case["nx"], case["ny"], 1e5, 1.0, 1e-3, 1.0, "rbc",
+                periodic=case["periodic"], mesh=mesh,
+            )
+            model.set_velocity(0.1, 1.0, 1.0)
+            model.set_temperature(0.1, 1.0, 1.0)
+            return model
+
+        serial = build(None)
+        sharded = build(make_mesh())
+        serial.update_n(3)
+        sharded.update_n(3)
+        for attr in ("temp", "velx", "vely", "pres", "pseu"):
+            np.testing.assert_allclose(
+                np.asarray(getattr(sharded.state, attr)),
+                np.asarray(getattr(serial.state, attr)),
+                atol=1e-11,
+                err_msg=f"{case}: {attr}",
+            )

@@ -15,6 +15,7 @@ def _adjoint(nx=33, ra=1e4, dt=5e-3, bc="rbc"):
     return model
 
 
+@pytest.mark.slow
 def test_residual_decreases():
     model = _adjoint()
     model.update_n(50)
@@ -40,6 +41,7 @@ def test_subcritical_converges_to_conduction():
     assert model.eval_nu() == pytest.approx(1.0, abs=1e-4)
 
 
+@pytest.mark.slow
 def test_supercritical_descends_toward_steady_state():
     """Ra=5e3 > Ra_c: the residual decreases monotonically-ish and the state
     approaches a convective steady state whose forward-DNS Nu drift is small.
