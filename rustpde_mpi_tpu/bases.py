@@ -68,7 +68,7 @@ _FAST_DERIV = os.environ.get("RUSTPDE_FAST_DERIV", "auto")
 _FAST_DERIV_MIN = int(os.environ.get("RUSTPDE_FAST_DERIV_MIN", "2048"))
 
 
-def _fast_deriv_enabled(n: int) -> bool:
+def _fast_deriv_enabled(n: int, sep: bool = False) -> bool:
     """Chebyshev derivatives via the parity-cumsum recurrence
     (ops/transforms.cheb_derivative) instead of dense triangular GEMMs.
     ``RUSTPDE_FAST_DERIV``: "auto" (default), "1" (always), "0" (never).
@@ -76,12 +76,15 @@ def _fast_deriv_enabled(n: int) -> bool:
     round 3): f32 cumsum 0.22 vs GEMM 0.46 ms at 2049 but 0.11 vs 0.07 at
     1025 (dispatch/bandwidth bound), and in *emulated f64* the cumsum's scan
     ops are 2-5x slower than the MXU GEMM at every tested size — so the
-    recurrence engages only for f32 at n >= 2048."""
+    recurrence engages only for f32 at n >= 2048.  Under the parity-
+    separated layout the GEMM gradient is gather-free block MXU work and
+    auto never engages: measured at the 2049^2 step (round 4), cumsum
+    18.7 ms vs GEMM 16.4 ms."""
     if _FAST_DERIV == "0":
         return False
     if _FAST_DERIV == "1":
         return True
-    return n >= _FAST_DERIV_MIN and not config.X64
+    return n >= _FAST_DERIV_MIN and not config.X64 and not sep
 
 
 def _dev(mat: np.ndarray):
@@ -254,6 +257,27 @@ class Base:
                 cache[key] = FoldedMatrix(self.projection, _dev, sep_in=True, sep_out=True)
             elif key == "synthesis":
                 cache[key] = FoldedMatrix(chb.synthesis_matrix(self.n), _dev, sep_in=True)
+            elif isinstance(key, tuple) and key[0] == "bwd_grad":
+                # synthesis-of-derivative fusion: physical values of the
+                # order-th derivative straight from composite coefficients —
+                # one GEMM instead of gradient + synthesis (the odd-order
+                # product carries the sign-shifted synthesis symmetry,
+                # ops/folded._SynthesisSep sign=-1)
+                cache[key] = FoldedMatrix(
+                    chb.synthesis_matrix(self.n) @ self.gradient_matrix(key[1]),
+                    _dev,
+                    sep_in=True,
+                )
+            elif key == "fwd_cut":
+                # forward with the 2/3-rule dealias folded in: the zeroed
+                # output modes are dropped from the GEMM (keep_rows), so the
+                # dealiased forward costs 2/3 flops and no mask multiply
+                cache[key] = FoldedMatrix(
+                    self.projection @ chb.analysis_matrix(self.n),
+                    _dev,
+                    sep_out=True,
+                    keep_rows=self.m * 2 // 3,
+                )
             else:
                 cache[key] = FoldedMatrix(
                     self.gradient_matrix(key[1]), _dev, sep_in=True, sep_out=True
@@ -410,6 +434,11 @@ class Base:
             return self.to_ortho(vhat, axis, sep)
         if self.kind.is_chebyshev:
             if sep:
+                if _fast_deriv_enabled(self.n, sep=True):
+                    # the recurrence's parity split IS the sep storage order
+                    return tr.cheb_derivative_sep(
+                        self.to_ortho(vhat, axis, sep=True), order, axis
+                    )
                 return self._sep_dev(("grad", order)).apply(vhat, axis)
             if _fast_deriv_enabled(self.n):
                 # banded stencil + parity-cumsum recurrence: O(n) per lane
@@ -776,6 +805,40 @@ class Space2:
             constrain(out, PHYS), ax + 1, self._axis_method(1), sep=self.sep[1]
         )
         return constrain(out, PHYS)
+
+    def forward_dealiased(self, v):
+        """Physical -> spectral with the 2/3-rule mask applied, in one fused
+        form: on all-sep spaces the dead rows are dropped from the forward
+        GEMMs (2/3 flops, no mask pass).  Callers keep a ``forward() * mask``
+        fallback for other configurations."""
+        from .parallel.mesh import PHYS, SPEC, constrain
+
+        if not all(self.sep):
+            raise ValueError("forward_dealiased requires an all-sep space")
+        ax = self._batch_ax(v)
+        out = self.bases[1]._sep_dev("fwd_cut").apply(constrain(v, PHYS), ax + 1)
+        out = self.bases[0]._sep_dev("fwd_cut").apply(constrain(out, SPEC), ax)
+        return constrain(out, SPEC)
+
+    def backward_gradient(self, vhat, deriv, scale=None):
+        """Physical values of d^deriv[0]/dx d^deriv[1]/dy — the fused
+        ``backward_ortho(gradient(...))``: on all-sep spaces each axis is ONE
+        synthesis-of-derivative GEMM (key ("bwd_grad", order); order 0 is the
+        plain fused backward), saving the separate gradient apply per axis."""
+        from .parallel.mesh import PHYS, SPEC, constrain
+
+        if not all(self.sep):
+            return self.backward_ortho(self.gradient(vhat, deriv, scale))
+        ax = self._batch_ax(vhat)
+        keys = [("bwd_grad", d) if d else "bwd" for d in deriv]
+        out = self.bases[0]._sep_dev(keys[0]).apply(constrain(vhat, SPEC), ax)
+        out = self.bases[1]._sep_dev(keys[1]).apply(constrain(out, PHYS), ax + 1)
+        out = constrain(out, PHYS)
+        if scale is not None:
+            factor = (scale[0] ** deriv[0]) * (scale[1] ** deriv[1])
+            if factor != 1.0:
+                out = out / factor
+        return out
 
     def to_ortho(self, vhat):
         ax = self._batch_ax(vhat)

@@ -142,6 +142,8 @@ class Navier2DLnse(Integrate):
             return sp_f.backward_ortho(space.gradient(vhat, deriv, scale))
 
         def conv(total):
+            if all(sp_f.sep):
+                return sp_f.forward_dealiased(total)
             return sp_f.forward(total) * mask
 
         # mean-balance constants of the perturbation form (nonlin_eq.rs):
@@ -256,6 +258,8 @@ class Navier2DLnse(Integrate):
             return sp_f.backward_ortho(space.gradient(vhat, deriv, scale))
 
         def conv(total):
+            if all(sp_f.sep):
+                return sp_f.forward_dealiased(total)
             return sp_f.forward(total) * mask
 
         def step(state: NavierState, history=None) -> NavierState:

@@ -171,6 +171,8 @@ class Navier2DAdjoint(Integrate):
             that_full = sp_t.to_ortho(ns.temp) + tb_ortho
 
             def conv(total):
+                if all(sp_f.sep):
+                    return sp_f.forward_dealiased(total)
                 return sp_f.forward(total) * mask
 
             # x-momentum adjoint convection (steady_adjoint_eq.rs:258-289):

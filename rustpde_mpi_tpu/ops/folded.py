@@ -174,6 +174,9 @@ class _AnalysisFold:
     def device_parts(self, to_dev):
         return (to_dev(self.m_e), to_dev(self.m_o))
 
+    def _combine(self, y_e, y_o):
+        return _interleave(y_e, y_o, self.r)
+
     def apply(self, dev, a, axis: int):
         m_e, m_o = dev
         x = _move(a, axis)
@@ -185,7 +188,7 @@ class _AnalysisFold:
             u = jnp.concatenate([u, x[h : h + 1]], axis=0)
         y_e = jnp.tensordot(m_e, u, axes=([1], [0]))
         y_o = jnp.tensordot(m_o, v, axes=([1], [0]))
-        return _unmove(_interleave(y_e, y_o, self.r), axis)
+        return _unmove(self._combine(y_e, y_o), axis)
 
 
 class _SynthesisFold:
@@ -244,33 +247,53 @@ class _CheckerFold:
 
 class _AnalysisSep(_AnalysisFold):
     """Analysis-type apply with sep-layout output: the even/odd half-GEMM
-    results concatenate contiguously instead of interleaving."""
+    results concatenate contiguously instead of interleaving.
+
+    ``keep_rows``: only the first ``keep_rows`` NATURAL output modes are
+    nonzero (a prefix dealias cut); the GEMMs drop the dead rows and the
+    output is zero-padded — the 2/3-rule forward costs 2/3 of the flops and
+    needs no separate mask multiply."""
 
     kind = "analysis_sep"
 
-    def apply(self, dev, a, axis: int):
-        m_e, m_o = dev
-        x = _move(a, axis)
-        h, n = self.h, self.n
-        xr = x[::-1]
-        u = x[:h] + xr[:h]
-        v = x[:h] - xr[:h]
-        if n % 2 == 1:
-            u = jnp.concatenate([u, x[h : h + 1]], axis=0)
-        y_e = jnp.tensordot(m_e, u, axes=([1], [0]))
-        y_o = jnp.tensordot(m_o, v, axes=([1], [0]))
-        return _unmove(jnp.concatenate([y_e, y_o], axis=0), axis)
+    def __init__(self, mat: np.ndarray, keep_rows: int | None = None):
+        super().__init__(mat)
+        r = self.r
+        self.re = (r + 1) // 2  # even-block size of the sep output
+        if keep_rows is None or keep_rows >= r:
+            self.keep = None
+        else:
+            k = max(0, keep_rows)
+            self.keep = ((k + 1) // 2, k // 2)  # kept rows per parity block
+            self.m_e = self.m_e[: self.keep[0]]
+            self.m_o = self.m_o[: self.keep[1]]
+            self.flops_factor = 0.5 * k / r if r else 0.0
+            self.kind = "analysis_sep_cut"
+
+    def _combine(self, y_e, y_o):
+        if self.keep is None:
+            return jnp.concatenate([y_e, y_o], axis=0)
+        ke, ko = self.keep
+        batch = y_e.shape[1:]
+        z_e = jnp.zeros((self.re - ke,) + batch, dtype=y_e.dtype)
+        z_o = jnp.zeros((self.r - self.re - ko,) + batch, dtype=y_o.dtype)
+        return jnp.concatenate([y_e, z_e, y_o, z_o], axis=0)
 
 
 class _SynthesisSep(_SynthesisFold):
     """Synthesis-type apply with sep-layout input: contiguous slices instead
-    of strided gathers."""
+    of strided gathers.
+
+    ``sign``: +1 for the plain synthesis symmetry ``M[n-1-i,k] =
+    (-1)^k M[i,k]``; -1 for the sign-shifted variant ``(-1)^(k+1)`` that
+    synthesis-of-odd-derivative fusions (``Syn @ D @ S``) carry."""
 
     kind = "synthesis_sep"
 
-    def __init__(self, mat: np.ndarray):
+    def __init__(self, mat: np.ndarray, sign: float = 1.0):
         super().__init__(mat)
         self.ce = (mat.shape[1] + 1) // 2  # even-block size of the sep input
+        self.sign = sign
 
     def apply(self, dev, a, axis: int):
         m_e, m_o = dev
@@ -279,12 +302,78 @@ class _SynthesisSep(_SynthesisFold):
         B = jnp.tensordot(m_o, x[self.ce :], axes=([1], [0]))
         top = A + B
         floor = self.n // 2
-        bottom = (A - B)[:floor][::-1]
+        bottom = (self.sign * (A - B))[:floor][::-1]
         return _unmove(jnp.concatenate([top, bottom], axis=0), axis)
 
 
+class _StripTrapezoid:
+    """Upper-trapezoidal dense block (the Chebyshev derivative factors
+    ``D^o @ S``: row k couples only columns ``>= k - bandwidth``): split the
+    output rows into strips, each strip's GEMM starting at its first nonzero
+    column — the zero lower-left triangle the full dense GEMM pays for is
+    skipped.  4 strips recover ~37% of a perfectly triangular block's flops;
+    the strips stay MXU-sized (>=256 rows at the production grids)."""
+
+    kind = "trapezoid"
+
+    def __init__(self, mat: np.ndarray, row_starts, col_starts):
+        self.bounds = []
+        mats = []
+        r, c = mat.shape
+        for i, (r0, c0) in enumerate(zip(row_starts, col_starts)):
+            r1 = row_starts[i + 1] if i + 1 < len(row_starts) else r
+            self.bounds.append((r0, r1, c0))
+            mats.append(np.ascontiguousarray(mat[r0:r1, c0:]))
+        self.mats = mats  # host copies; dropped by FoldedMatrix cleanup
+        self.flops_factor = (
+            sum((r1 - r0) * (c - c0) for r0, r1, c0 in self.bounds) / (r * c)
+            if r * c
+            else 0.0
+        )
+
+    def device_parts(self, to_dev):
+        return tuple(to_dev(m) for m in self.mats)
+
+    def apply(self, dev, a, axis: int):
+        x = _move(a, axis)
+        parts = [
+            jnp.tensordot(m, x[c0:], axes=([1], [0]))
+            for m, (_, _, c0) in zip(dev, self.bounds)
+        ]
+        return _unmove(jnp.concatenate(parts, axis=0), axis)
+
+
+_TRAP_MIN_DIM = 192  # strips below this lose more to GEMM granularity than
+_TRAP_MAX_FACTOR = 0.85  # ... the skipped flops save; engage only when the
+#                          trapezoid actually removes >=15% of the block
+
+
+def _detect_trapezoid(mat: np.ndarray):
+    """Strip decomposition when the block has a zero lower-left triangle
+    (exact zeros — the derivative/stencil products are constructed so)."""
+    r, c = mat.shape
+    if min(r, c) < _TRAP_MIN_DIM:
+        return None
+    nz = mat != 0.0
+    if not nz.any():
+        return None
+    # first nonzero column of each row (c for all-zero rows)
+    first = np.where(nz.any(axis=1), nz.argmax(axis=1), c)
+    strips = max(2, min(8, r // _TRAP_MIN_DIM))
+    row_starts = [(r * i) // strips for i in range(strips)]
+    col_starts = []
+    for i, r0 in enumerate(row_starts):
+        r1 = row_starts[i + 1] if i + 1 < len(row_starts) else r
+        col_starts.append(int(first[r0:r1].min(initial=c)))
+    trap = _StripTrapezoid(mat, row_starts, col_starts)
+    if trap.flops_factor > _TRAP_MAX_FACTOR:
+        return None
+    return trap
+
+
 def _detect_block(mat: np.ndarray):
-    """Banded-else-plain detection for the parity blocks of a sep operator."""
+    """Banded / trapezoid / plain detection for the parity blocks of a sep
+    operator."""
     r, c = mat.shape
     if min(r, c) >= 4:
         scale = np.abs(mat).max() or 1.0
@@ -294,8 +383,13 @@ def _detect_block(mat: np.ndarray):
             offs = np.unique(cols - rows)
             if offs.size <= _MAX_BAND_OFFSETS and offs.size * 4 <= c:
                 kept = np.isin(np.arange(c)[None, :] - np.arange(r)[:, None], offs)
-                if np.abs(np.where(kept, 0.0, mat)).max() <= _ATOL * scale:
+                # same lossless-only acceptance as _detect: the banded apply
+                # drops off-band entries, so they must be exact zeros
+                if not np.any(np.where(kept, 0.0, mat)):
                     return _BandedApply(mat, offs)
+        trap = _detect_trapezoid(mat)
+        if trap is not None:
+            return trap
     return _Plain(mat)
 
 
@@ -338,7 +432,7 @@ class _SepBoth:
         return _unmove(jnp.concatenate([y_e, y_o], axis=0), axis)
 
 
-def _detect_sep(mat: np.ndarray, sep_in: bool, sep_out: bool):
+def _detect_sep(mat: np.ndarray, sep_in: bool, sep_out: bool, keep_rows=None):
     """Impl selection for sep-layout sides.  Unstructured matrices absorb the
     permutation into the dense operator (conjugation on the host — zero
     runtime cost); structured ones get the gather-free block applies."""
@@ -360,19 +454,22 @@ def _detect_sep(mat: np.ndarray, sep_in: bool, sep_out: bool):
         if structured:
             sgn_r = (-1.0) ** np.arange(r)[:, None]
             if np.abs(mat[:, ::-1] - sgn_r * mat).max() < _ATOL * scale:
-                return _AnalysisSep(mat)
+                return _AnalysisSep(mat, keep_rows=keep_rows)
+        if keep_rows is not None and keep_rows < r:
+            mat = np.where(np.arange(r)[:, None] < keep_rows, mat, 0.0)
         return _Plain(mat[parity_perm(r), :])
     # sep input -> physical/natural output (synthesis position)
     if structured:
         sgn_c = (-1.0) ** np.arange(c)[None, :]
-        if np.abs(mat[::-1, :] - sgn_c * mat).max() < _ATOL * scale:
-            return _SynthesisSep(mat)
+        for sign in (1.0, -1.0):
+            if np.abs(mat[::-1, :] - sign * sgn_c * mat).max() < _ATOL * scale:
+                return _SynthesisSep(mat, sign)
     return _Plain(mat[:, parity_perm(c)])
 
 
-def _detect(mat: np.ndarray, sep_in: bool = False, sep_out: bool = False):
+def _detect(mat: np.ndarray, sep_in: bool = False, sep_out: bool = False, keep_rows=None):
     if sep_in or sep_out:
-        return _detect_sep(np.asarray(mat), sep_in, sep_out)
+        return _detect_sep(np.asarray(mat), sep_in, sep_out, keep_rows)
     if not folding_enabled():
         return _Plain(mat)
     if np.iscomplexobj(mat) or mat.ndim != 2 or min(mat.shape) < 4:
@@ -450,8 +547,11 @@ class FoldedMatrix:
     ``FoldedMatrix(host_matrix, to_dev).apply(a, axis)``.  ``to_dev`` is the
     host->device constant placement (bases._dev)."""
 
-    def __init__(self, mat: np.ndarray, to_dev, sep_in: bool = False, sep_out: bool = False):
-        self._impl = _detect(np.asarray(mat), sep_in, sep_out)
+    def __init__(
+        self, mat: np.ndarray, to_dev, sep_in: bool = False, sep_out: bool = False,
+        keep_rows=None,
+    ):
+        self._impl = _detect(np.asarray(mat), sep_in, sep_out, keep_rows)
         self._dev = self._impl.device_parts(to_dev)
         # drop the host copies — apply() reads only the device parts and the
         # scalar shape metadata (at 2049^2 f64 a retained inverse is ~33 MB);
@@ -460,7 +560,7 @@ class FoldedMatrix:
         stack = [self._impl]
         while stack:
             impl = stack.pop()
-            for attr in ("mat", "m_e", "m_o"):
+            for attr in ("mat", "m_e", "m_o", "mats"):
                 if hasattr(impl, attr):
                     setattr(impl, attr, None)
             inner = getattr(impl, "_inner", None)

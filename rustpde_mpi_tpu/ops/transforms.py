@@ -157,6 +157,36 @@ def cheb_derivative(c, order: int, axis: int):
     return jnp.moveaxis(x, 0, axis)
 
 
+def cheb_derivative_sep(c, order: int, axis: int):
+    """:func:`cheb_derivative` for coefficients in the parity-separated
+    layout (ops/folded.py): the parity split the recurrence needs is already
+    the storage order, so the strided gathers and the output interleave
+    become contiguous slices and a concat."""
+    x = jnp.moveaxis(c, axis, 0)
+    n = x.shape[0]
+    rdt = x.real.dtype if jnp.iscomplexobj(x) else x.dtype
+    ne = (n + 1) // 2
+    no = n // 2
+    shape_e = (ne,) + (1,) * (x.ndim - 1)
+    shape_o = (no,) + (1,) * (x.ndim - 1)
+    j_e = (2.0 * jnp.arange(ne, dtype=rdt)).reshape(shape_e)
+    j_o = (2.0 * jnp.arange(no, dtype=rdt) + 1.0).reshape(shape_o)
+    for _ in range(order):
+        w_e = x[:ne] * j_e
+        w_o = x[ne:] * j_o
+        rev_e = jnp.cumsum(jnp.flip(w_e, 0), axis=0)[::-1]  # sum_{p even >= k}
+        rev_o = jnp.cumsum(jnp.flip(w_o, 0), axis=0)[::-1]  # sum_{p odd >= k}
+        out_e = 2.0 * rev_o
+        if ne > no:  # odd n: top even mode has an empty sum
+            out_e = jnp.concatenate([out_e, jnp.zeros_like(out_e[:1])], axis=0)
+        out_o = 2.0 * rev_e[1:]
+        if no > ne - 1:  # even n: top odd mode has an empty sum
+            out_o = jnp.concatenate([out_o, jnp.zeros_like(out_o[:1])], axis=0)
+        x = jnp.concatenate([out_e, out_o], axis=0)
+        x = x.at[0].multiply(0.5)  # natural mode 0 sits at sep position 0
+    return jnp.moveaxis(x, 0, axis)
+
+
 # ----------------------------------------------------------------------------
 # matmul application (MXU path); mat is a host numpy or jnp constant
 # ----------------------------------------------------------------------------
