@@ -11,7 +11,7 @@ Environment knobs:
     RUSTPDE_BENCH_CONFIGS  comma list / "all" (default) /
                            names: rbc129, periodic, poisson1025,
                                   poisson1025_f64, rbc1025, rbc1025_f64,
-                                  sh2048, rbc2049, rbc129_f64
+                                  sh2048, rbc2049, rbc2049_f64, rbc129_f64
     RUSTPDE_BENCH_STEPS    timed window for the primary config (default 64;
                            rates are slope-timed over windows L and 4L, see
                            utils/profiling.benchmark_steps)
@@ -62,6 +62,7 @@ DEFAULT_CONFIGS = [
     "poisson1025",
     "poisson1025_f64",
     "rbc129_f64",
+    "rbc2049_f64",
 ]
 # always run first, in this order, when selected: the two flagship sizes and
 # the f64 shadow anchor must be fresh at HEAD in every driver capture
@@ -224,12 +225,16 @@ def main() -> int:
                 # small configs need a longer timed window: 64 steps is an
                 # ~100 ms measurement through the relay, dominated by noise
                 r = bench_navier(129, 129, 1e7, 2e-3, max(steps, 256))
-            elif name in ("rbc129_f64", "rbc1025_f64", "poisson1025_f64"):
+            elif name in ("rbc129_f64", "rbc1025_f64", "rbc2049_f64", "poisson1025_f64"):
                 env = dict(os.environ, RUSTPDE_X64="1")
                 import subprocess
 
                 if name == "rbc129_f64":
                     call = f"bench.bench_navier(129,129,1e7,2e-3,{max(steps, 256)})"
+                elif name == "rbc2049_f64":
+                    # first-ever f64 record at the flagship size (VERDICT r3
+                    # #3); short window — the slope timing keeps it honest
+                    call = "bench.bench_navier(2049,2049,1e9,5e-5,8)"
                 elif name == "poisson1025_f64":
                     # BASELINE config #3's accuracy number (8.1e-8 expected):
                     # the f64 error belongs in the driver-visible matrix, not
@@ -304,6 +309,7 @@ def main() -> int:
         "rbc1025": "2D RBC confined 1025x1025 Ra=1e9",
         "rbc1025_f64": "2D RBC confined 1025x1025 Ra=1e9",
         "rbc2049": "2D RBC confined 2049x2049 Ra=1e9",
+        "rbc2049_f64": "2D RBC confined 2049x2049 Ra=1e9",
         "rbc129": "2D RBC confined 129x129 Ra=1e7",
         "rbc129_f64": "2D RBC confined 129x129 Ra=1e7",
         "periodic": "2D RBC periodic 128x65 Ra=1e6",
