@@ -1,0 +1,48 @@
+"""Custom diverging colormap for the RBC plots.
+
+The reference ships a tabulated "goldfish" diverging map and two brand
+colors (/root/reference/plot/utils/colors.py: gfblue3/gfred3 +
+gfcmap.json).  This rebuild constructs an equivalent blue-white-red
+diverging map *programmatically* — smooth linear interpolation through the
+same two anchor colors with a white midpoint, plus darkened outer stops so
+extreme values stay readable — instead of shipping tabulated segment data.
+
+Use: ``from colors import set_gfcmap; set_gfcmap()`` then ``cmap="gfcmap"``
+anywhere matplotlib accepts a registered name.  plot_utils uses it as the
+default diverging map when available.
+"""
+
+from __future__ import annotations
+
+# anchor colors (same named palette as the reference)
+gfblue3 = (0 / 255, 137 / 255, 204 / 255)
+gfred3 = (196 / 255, 0 / 255, 96 / 255)
+
+
+def _darken(rgb, f=0.45):
+    return tuple(c * f for c in rgb)
+
+
+def gfcmap():
+    """Blue-white-red diverging colormap through the goldfish anchors."""
+    from matplotlib.colors import LinearSegmentedColormap
+
+    stops = [
+        (0.0, _darken(gfblue3)),
+        (0.25, gfblue3),
+        (0.5, (1.0, 1.0, 1.0)),
+        (0.75, gfred3),
+        (1.0, _darken(gfred3)),
+    ]
+    return LinearSegmentedColormap.from_list("gfcmap", stops, N=512)
+
+
+def set_gfcmap() -> str:
+    """Register the map with matplotlib (idempotent); returns the name."""
+    import matplotlib
+
+    try:
+        matplotlib.colormaps.register(gfcmap(), name="gfcmap")
+    except ValueError:
+        pass  # already registered
+    return "gfcmap"

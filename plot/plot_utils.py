@@ -41,7 +41,21 @@ def plot_contour(
     xx, yy = np.meshgrid(x, y, indexing="ij")
     if diverging:
         levels = _symmetric_levels(field)
-        cmap = "RdBu_r"
+        try:  # custom goldfish-style diverging map (plot/colors.py) —
+            # anchored import so a third-party "colors" package on sys.path
+            # cannot shadow it; only a missing module falls back
+            import importlib.util
+            import os as _os
+
+            _spec = importlib.util.spec_from_file_location(
+                "_rustpde_plot_colors",
+                _os.path.join(_os.path.dirname(_os.path.abspath(__file__)), "colors.py"),
+            )
+            _mod = importlib.util.module_from_spec(_spec)
+            _spec.loader.exec_module(_mod)
+            cmap = _mod.set_gfcmap()
+        except FileNotFoundError:
+            cmap = "RdBu_r"
     else:
         levels = 21
         cmap = "viridis"

@@ -389,9 +389,10 @@ class Navier2D(Integrate):
             compiled program the extra stack/unstack HBM copies and the
             batched dot_generals cost more than the saved op count."""
             # fused synthesis-of-derivative: one GEMM per axis on sep spaces
-            # (Space2.backward_gradient == backward_ortho(gradient(.)))
-            dvdx = space.backward_gradient(vhat, (1, 0), scale)
-            dvdy = space.backward_gradient(vhat, (0, 1), scale)
+            # (Space2.backward_gradient == backward_ortho(gradient(.)));
+            # fast=True: 3-pass synthesis for the dealiased products
+            dvdx = space.backward_gradient(vhat, (1, 0), scale, fast=True)
+            dvdy = space.backward_gradient(vhat, (0, 1), scale, fast=True)
             total = ux * dvdx + uy * dvdy
             if with_bc:
                 total = total + ux * tb_dx + uy * tb_dy
@@ -404,9 +405,10 @@ class Navier2D(Integrate):
             temp, velx, vely, pres, pseu = state
             # buoyancy (full ortho space, includes the lift field)
             that = sp_t.to_ortho(temp) + tb_ortho
-            # convection velocity in physical space (old time level)
-            ux = sp_u.backward(velx)
-            uy = sp_v.backward(vely)
+            # convection velocity in physical space (old time level; fast
+            # 3-pass synthesis — feeds only the dealiased products)
+            ux = sp_u.backward_fast(velx)
+            uy = sp_v.backward_fast(vely)
 
             # horizontal momentum (navier_eq.rs:176-187)
             rhs = sp_u.to_ortho(velx)

@@ -290,6 +290,11 @@ class _SynthesisSep(_SynthesisFold):
 
     kind = "synthesis_sep"
 
+    #: optional per-impl matmul precision override (None = session default);
+    #: set by FoldedMatrix for experiments like the synthesis-only 3-pass
+    #: mode (RUSTPDE_SYNTH_PRECISION)
+    precision = None
+
     def __init__(self, mat: np.ndarray, sign: float = 1.0):
         super().__init__(mat)
         self.ce = (mat.shape[1] + 1) // 2  # even-block size of the sep input
@@ -298,8 +303,8 @@ class _SynthesisSep(_SynthesisFold):
     def apply(self, dev, a, axis: int):
         m_e, m_o = dev
         x = _move(a, axis)
-        A = jnp.tensordot(m_e, x[: self.ce], axes=([1], [0]))
-        B = jnp.tensordot(m_o, x[self.ce :], axes=([1], [0]))
+        A = jnp.tensordot(m_e, x[: self.ce], axes=([1], [0]), precision=self.precision)
+        B = jnp.tensordot(m_o, x[self.ce :], axes=([1], [0]), precision=self.precision)
         top = A + B
         floor = self.n // 2
         bottom = (self.sign * (A - B))[:floor][::-1]

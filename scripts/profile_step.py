@@ -108,12 +108,30 @@ def main():
 
         rec("fourstep DCT pair (2 axes each way)", timeit(fast_pair, v, it))
 
-    # derivative: cumsum vs checker GEMM
+    # derivative: cumsum vs checker GEMM vs the sep trapezoid strips
     from rustpde_mpi_tpu.ops import transforms as tr
 
     rec("cheb_derivative cumsum (1 axis)", timeit(lambda a: tr.cheb_derivative(a, 1, 0), v, it))
     gm = base._gradient_dev(1)
     rec("gradient checker GEMM (1 axis)", timeit(lambda a: gm.apply(a, 0), v, it))
+    if any(sp_u.sep):
+        m_c = sp_u.base_x.m
+        gs = sp_u.base_x._sep_dev(("grad", 1))
+        vu_g = jnp.asarray(rng.standard_normal((m_c, n)), dtype=rdt)
+        rec(
+            f"gradient sep ({gs.kind}) (1 axis)",
+            timeit(lambda a: gs.apply(a, 0)[:m_c], vu_g, it),
+        )
+        bg = sp_u.base_x._sep_dev(("bwd_grad", 1))
+        rec(
+            "bwd_grad fused synthesis-of-derivative (1 axis)",
+            timeit(lambda a: bg.apply(a, 0)[:m_c], vu_g, it),
+        )
+    if all(sp_f.sep):
+        rec(
+            "forward_dealiased (2 axes, rows dropped)",
+            timeit(sp_f.forward_dealiased, v, it),
+        )
 
     # banded apply vs what it replaced (slice keeps the scan carry shape)
     st = sp_u.base_x._stencil_dev

@@ -81,3 +81,31 @@ def test_sep_layout_roundtrip_io_boundary():
         np.asarray(b),
         atol=0,
     )
+
+
+@pytest.mark.parametrize("deriv", [(0, 0), (1, 0), (0, 1)])
+def test_backward_gradient_fast_matches(deriv, monkeypatch):
+    """The fast-key plumbing (('bwd','fast') / ('bwd_grad',o,'fast')): under
+    X64 (the CI default) no downgrade happens, so fast == exact bitwise; the
+    key construction and base_key slicing are exercised either way."""
+    sep = rp.Space2(rp.cheb_dirichlet(33), rp.cheb_neumann(32), sep=True, method="matmul")
+    assert all(sep.sep)
+    rng = np.random.default_rng(7)
+    vhat = sep.forward(rng.standard_normal(sep.shape_physical))
+    fast = np.asarray(sep.backward_gradient(vhat, deriv, (1.0, 2.0), fast=True))
+    exact = np.asarray(sep.backward_gradient(vhat, deriv, (1.0, 2.0), fast=False))
+    np.testing.assert_array_equal(fast, exact)
+    # the alias path: fast keys must map to the SAME cached FoldedMatrix
+    base = sep.bases[0]
+    key = ("bwd_grad", 1) if deriv[0] else "bwd"
+    fkey = key + ("fast",) if isinstance(key, tuple) else (key, "fast")
+    assert base._sep_dev(fkey) is base._sep_dev(key)
+
+
+def test_backward_fast_matches_backward():
+    sep = rp.Space2(rp.cheb_dirichlet(17), rp.cheb_dirichlet(16), sep=True, method="matmul")
+    rng = np.random.default_rng(8)
+    vhat = sep.forward(rng.standard_normal(sep.shape_physical))
+    np.testing.assert_array_equal(
+        np.asarray(sep.backward_fast(vhat)), np.asarray(sep.backward(vhat))
+    )
