@@ -61,6 +61,29 @@ def enable_compilation_cache(path: str | None = None) -> str:
     return path
 
 
+def host_cache_dir() -> str:
+    """Root for host-side factorization caches (modal eigs, dense inverses):
+    a ``host/`` subdir of the XLA compilation cache root, honoring
+    JAX_COMPILATION_CACHE_DIR like enable_compilation_cache does."""
+    root = os.environ.get(
+        "JAX_COMPILATION_CACHE_DIR",
+        os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), ".jax_cache"),
+    )
+    return os.path.join(root, "host")
+
+
+def host_cache_store(path: str, save_fn) -> None:
+    """Best-effort atomic publish of a host cache entry: ``save_fn(tmp)``
+    writes the temp file (suffix chosen by the caller), then os.replace."""
+    try:
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        tmp = f"{path}.{os.getpid()}.tmp{os.path.splitext(path)[1]}"
+        save_fn(tmp)
+        os.replace(tmp, path)
+    except OSError:
+        pass
+
+
 def real_dtype():
     """Default real dtype for device arrays."""
     return np.float64 if X64 else np.float32

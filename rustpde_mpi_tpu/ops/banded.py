@@ -126,6 +126,29 @@ def _banded_solve_moved(lower, upper, p: int, q: int, b):
     return jnp.moveaxis(x, 0, -1)
 
 
+def _cached_inverse(dense: np.ndarray) -> np.ndarray:
+    """Host matrix inversion with a best-effort disk cache (content-hash
+    keyed, exact f64 round-trip) — the O(n^3) inversions are a visible part
+    of flagship-size model build time."""
+    import hashlib
+    import os
+
+    from .. import config
+
+    n = dense.shape[-1]
+    if n < 512:  # cheap; not worth the IO
+        return np.linalg.inv(dense)
+    key = hashlib.blake2b(dense.tobytes(), digest_size=12).hexdigest()
+    path = os.path.join(config.host_cache_dir(), f"inv_{n}_{key}.npy")
+    try:
+        return np.load(path)
+    except Exception:  # missing/corrupt/format-drift: recompute
+        pass
+    inv = np.linalg.inv(dense)
+    config.host_cache_store(path, lambda tmp: np.save(tmp, inv))
+    return inv
+
+
 class DenseSolver:
     """Precomputed dense inverse; solve = one GEMM (MXU path for static
     well-conditioned systems).  Parity-preserving operators (every pure-
@@ -138,7 +161,7 @@ class DenseSolver:
         from .folded import FoldedMatrix
 
         dt = dtype or jnp.zeros(0).dtype
-        inv = np.linalg.inv(np.asarray(dense, dtype=np.float64))
+        inv = _cached_inverse(np.asarray(dense, dtype=np.float64))
         self._folded = FoldedMatrix(
             inv, lambda m: jnp.asarray(m, dtype=dt), sep_in=sep, sep_out=sep
         )

@@ -30,6 +30,8 @@ All device work is GEMMs (MXU) + one batched banded substitution scan.
 
 from __future__ import annotations
 
+import os
+
 import jax.numpy as jnp
 import numpy as np
 
@@ -100,6 +102,29 @@ def _axis_modal_data(space: Space2, axis: int, ci: float, sign: float):
     if base.kind.is_periodic:
         return sign * ci * (-(base.wavenumbers**2)), None, None
     mat_c, mat_a, precond = ingredients_for_hholtz(space, axis)
+    # host-eig disk cache (SURVEY S7 "cache to disk for big N"): the
+    # nonsymmetric parity-block eigendecompositions dominate build time at
+    # the flagship sizes (~tens of seconds at 2049); exact f64 npz
+    # round-trips, keyed by the INGREDIENT CONTENT (cheap O(n^2) hash of the
+    # matrices actually decomposed — a code change to the preconditioner or
+    # eig ordering invalidates entries) plus ci/sign.  Gated to n >= 512:
+    # below that the eig costs less than the IO.
+    cache_path = None
+    if base.n >= 512:
+        import hashlib
+
+        h = hashlib.blake2b(digest_size=12)
+        for m in (mat_c, mat_a, precond):
+            h.update(np.ascontiguousarray(m).tobytes())
+        cache_path = os.path.join(
+            config.host_cache_dir(),
+            f"modal_{base.kind.value}_{base.n}_{float(ci):.17g}_{sign:g}_{h.hexdigest()}.npz",
+        )
+        try:
+            with np.load(cache_path) as z:
+                return z["lam"], z["fwd"], z["q"]
+        except Exception:  # missing/corrupt/format-drift: recompute
+            pass
     if (
         _checker_shift(mat_c) == 0
         and _checker_shift(mat_a) == 0
@@ -125,12 +150,18 @@ def _axis_modal_data(space: Space2, axis: int, ci: float, sign: float):
             lam[sl] = lam_b
             q[sl, sl] = q_b
             fwd[sl, sl] = fwd_b
-        return sign * ci * lam, fwd, q
+        return _modal_cache_store(cache_path, sign * ci * lam, fwd, q)
     # non-parity-preserving pencils (mixed Dirichlet-Neumann base): plain
     # descending eigen order, as in the reference (solver/utils.rs:88-95)
     lam, q = _real_eig_desc(np.linalg.solve(mat_c, mat_a))
     fwd = np.linalg.solve(q, np.linalg.solve(mat_c, precond))
-    return sign * ci * lam, fwd, q
+    return _modal_cache_store(cache_path, sign * ci * lam, fwd, q)
+
+
+def _modal_cache_store(path, lam, fwd, q):
+    if path is not None:
+        config.host_cache_store(path, lambda tmp: np.savez(tmp, lam=lam, fwd=fwd, q=q))
+    return lam, fwd, q
 
 
 class _AxisSolver:

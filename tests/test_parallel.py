@@ -262,3 +262,29 @@ def test_sharded_production_shape_matches():
                 atol=1e-11,
                 err_msg=f"{case}: {attr}",
             )
+
+
+def test_sharded_sep_layout_matches_serial(monkeypatch):
+    """The parity-separated layout + its fast-key step paths under the pencil
+    mesh (what a real multi-chip TPU runs: FORCE_TPU_PATH selects matmul
+    transforms, sep auto-engages) — sharded == serial."""
+    monkeypatch.setenv("RUSTPDE_FORCE_TPU_PATH", "1")
+
+    def build(mesh):
+        model = Navier2D(33, 32, 1e4, 1.0, 0.01, 1.0, "rbc", periodic=False, mesh=mesh)
+        model.set_velocity(0.1, 1.0, 1.0)
+        model.set_temperature(0.1, 1.0, 1.0)
+        assert all(model.temp_space.sep)  # the layout under test is active
+        return model
+
+    serial = build(None)
+    sharded = build(make_mesh())
+    serial.update_n(5)
+    sharded.update_n(5)
+    for attr in ("temp", "velx", "vely", "pres", "pseu"):
+        np.testing.assert_allclose(
+            np.asarray(getattr(sharded.state, attr)),
+            np.asarray(getattr(serial.state, attr)),
+            atol=1e-12,
+            err_msg=attr,
+        )
