@@ -973,6 +973,47 @@ class Space2:
         return self.spectral_from_natural(vhat_c)
 
 
+_PROJ_GRAD_CACHE: dict = {}
+
+
+def fused_projection_gradient(space_out: "Space2", space_in: "Space2", deriv):
+    """Per-axis cross-space operators applying
+    ``space_out.from_ortho(space_in.gradient(., deriv))`` as ONE GEMM per
+    axis: ``P_out @ D^order @ S_in`` (the pressure-projection velocity
+    correction in the Navier/LNSE/adjoint steps).  Returns a FoldedMatrix
+    pair, or None when the fusion does not apply (periodic axes — the
+    Fourier gradient is diagonal logic — or non-matmul transform methods,
+    where the unfused path uses the O(n) recurrences the fusion was never
+    benchmarked against).
+
+    Deduplicated by VALUE key (base kinds + sizes + order + sep flags —
+    operator matrices depend on nothing else), so e.g. the d/dx and d/dy
+    corrections of a square grid share their device constants."""
+    bases_all = tuple(space_in.bases) + tuple(space_out.bases)
+    if any(b.kind.is_periodic for b in bases_all):
+        return None
+    if space_out.method != "matmul" or space_in.method != "matmul":
+        return None
+    mats = []
+    for ax, order in enumerate(deriv):
+        b_out, b_in = space_out.bases[ax], space_in.bases[ax]
+        key = (
+            b_out.kind, b_out.n, b_in.kind, b_in.n, order,
+            space_in.sep[ax], space_out.sep[ax],
+        )
+        fm = _PROJ_GRAD_CACHE.get(key)
+        if fm is None:
+            fm = FoldedMatrix(
+                b_out.projection @ b_in.gradient_matrix(order),
+                _dev,
+                sep_in=space_in.sep[ax],
+                sep_out=space_out.sep[ax],
+            )
+            _PROJ_GRAD_CACHE[key] = fm
+        mats.append(fm)
+    return tuple(mats)
+
+
 class Space1:
     """One-dimensional spectral space — the funspace ``Space1`` analog the
     reference's 1-D fields are built on (/root/reference/src/field.rs:59-72;

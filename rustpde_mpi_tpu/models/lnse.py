@@ -130,6 +130,11 @@ class Navier2DLnse(Integrate):
         nu, ka = self.params["nu"], self.params["ka"]
         sp_t, sp_u, sp_v = nav.temp_space, nav.velx_space, nav.vely_space
         sp_p, sp_q, sp_f = nav.pres_space, nav.pseu_space, nav.field_space
+        from ..bases import fused_projection_gradient
+
+        _gx = fused_projection_gradient(sp_u, sp_q, (1, 0))
+        _gy = fused_projection_gradient(sp_v, sp_q, (0, 1))
+        proj_grad = (*_gx, *_gy) if _gx and _gy else None
         mask = nav._dealias
         mc = self._mean_constants()
         sol_u, sol_v, sol_t, sol_p = (
@@ -221,8 +226,14 @@ class Navier2DLnse(Integrate):
             )
             pseu_n = sol_p.solve(div)
             pseu_n = sp_q.pin_zero_mode(pseu_n)
-            velx_n = velx_n - sp_u.from_ortho(sp_q.gradient(pseu_n, (1, 0), scale))
-            vely_n = vely_n - sp_v.from_ortho(sp_q.gradient(pseu_n, (0, 1), scale))
+            if proj_grad is not None:
+                gx0, gx1, gy0, gy1 = proj_grad
+                pax = pseu_n.ndim - 2
+                velx_n = velx_n - gx1.apply(gx0.apply(pseu_n, pax), pax + 1) / scale[0]
+                vely_n = vely_n - gy1.apply(gy0.apply(pseu_n, pax), pax + 1) / scale[1]
+            else:
+                velx_n = velx_n - sp_u.from_ortho(sp_q.gradient(pseu_n, (1, 0), scale))
+                vely_n = vely_n - sp_v.from_ortho(sp_q.gradient(pseu_n, (0, 1), scale))
             pres_n = pres - nu * div + sp_q.to_ortho(pseu_n) / dt
 
             rhs = sp_t.to_ortho(temp)
@@ -247,6 +258,11 @@ class Navier2DLnse(Integrate):
         nu = self.params["nu"]
         sp_t, sp_u, sp_v = nav.temp_space, nav.velx_space, nav.vely_space
         sp_p, sp_q, sp_f = nav.pres_space, nav.pseu_space, nav.field_space
+        from ..bases import fused_projection_gradient
+
+        _gx = fused_projection_gradient(sp_u, sp_q, (1, 0))
+        _gy = fused_projection_gradient(sp_v, sp_q, (0, 1))
+        proj_grad = (*_gx, *_gy) if _gx and _gy else None
         mask = nav._dealias
         mc = self._mean_constants()
         sol_u, sol_v, sol_t, sol_p = (
@@ -322,8 +338,14 @@ class Navier2DLnse(Integrate):
             )
             pseu_n = sol_p.solve(div)
             pseu_n = sp_q.pin_zero_mode(pseu_n)
-            velx_n = velx_n - sp_u.from_ortho(sp_q.gradient(pseu_n, (1, 0), scale))
-            vely_n = vely_n - sp_v.from_ortho(sp_q.gradient(pseu_n, (0, 1), scale))
+            if proj_grad is not None:
+                gx0, gx1, gy0, gy1 = proj_grad
+                pax = pseu_n.ndim - 2
+                velx_n = velx_n - gx1.apply(gx0.apply(pseu_n, pax), pax + 1) / scale[0]
+                vely_n = vely_n - gy1.apply(gy0.apply(pseu_n, pax), pax + 1) / scale[1]
+            else:
+                velx_n = velx_n - sp_u.from_ortho(sp_q.gradient(pseu_n, (1, 0), scale))
+                vely_n = vely_n - sp_v.from_ortho(sp_q.gradient(pseu_n, (0, 1), scale))
             pres_n = pres - nu * div + sp_q.to_ortho(pseu_n) / dt
 
             rhs = sp_t.to_ortho(temp)

@@ -134,6 +134,16 @@ class Navier2D(Integrate):
         # dealiasing mask over the scratch spectral shape (split-aware)
         self._dealias = jnp.asarray(self.field_space.dealias_mask(), dtype=rdt)
 
+        # fused projection-gradient operators for the velocity correction
+        # (confined only; the periodic x-axis gradient is diagonal logic):
+        # velx -= P_u (D S_q) pseu / sx  per axis — one cross-space matrix
+        # per axis instead of gradient + to_ortho + 2 projection applies
+        from ..bases import fused_projection_gradient
+
+        gx = fused_projection_gradient(self.velx_space, self.pseu_space, (1, 0))
+        gy = fused_projection_gradient(self.vely_space, self.pseu_space, (0, 1))
+        self._proj_grad = (*gx, *gy) if gx and gy else None
+
         # boundary-condition lift fields as device constants
         with self._scope():
             self._build_bc_fields(xs, ys)
@@ -377,6 +387,7 @@ class Navier2D(Integrate):
             self.solver_pres,
         )
         solid = self._solid
+        proj_grad = self._proj_grad
 
         def conv(ux, uy, space, vhat, with_bc=False):
             """u . grad(v), dealiased, in scratch-ortho space
@@ -429,8 +440,14 @@ class Navier2D(Integrate):
             )
             pseu_n = sol_p.solve(div)
             pseu_n = sp_q.pin_zero_mode(pseu_n)  # remove singularity
-            velx_n = velx_n - sp_u.from_ortho(sp_q.gradient(pseu_n, (1, 0), scale))
-            vely_n = vely_n - sp_v.from_ortho(sp_q.gradient(pseu_n, (0, 1), scale))
+            if proj_grad is not None:
+                gx0, gx1, gy0, gy1 = proj_grad
+                ax = pseu_n.ndim - 2
+                velx_n = velx_n - gx1.apply(gx0.apply(pseu_n, ax), ax + 1) / scale[0]
+                vely_n = vely_n - gy1.apply(gy0.apply(pseu_n, ax), ax + 1) / scale[1]
+            else:
+                velx_n = velx_n - sp_u.from_ortho(sp_q.gradient(pseu_n, (1, 0), scale))
+                vely_n = vely_n - sp_v.from_ortho(sp_q.gradient(pseu_n, (0, 1), scale))
             pres_n = pres - nu * div + sp_q.to_ortho(pseu_n) / dt
 
             # temperature (navier_eq.rs:209-224)

@@ -131,6 +131,11 @@ class Navier2DAdjoint(Integrate):
         nu, ka = nav.params["nu"], nav.params["ka"]
         sp_t, sp_u, sp_v = nav.temp_space, nav.velx_space, nav.vely_space
         sp_p, sp_q, sp_f = nav.pres_space, nav.pseu_space, nav.field_space
+        from ..bases import fused_projection_gradient
+
+        _gx = fused_projection_gradient(sp_u, sp_q, (1, 0))
+        _gy = fused_projection_gradient(sp_v, sp_q, (0, 1))
+        proj_grad = (*_gx, *_gy) if _gx and _gy else None
         mask = nav._dealias
         tb_ortho = nav.tempbc_ortho
         nav_step = nav._make_step()
@@ -218,8 +223,14 @@ class Navier2DAdjoint(Integrate):
             )
             pseu_n = sol_p.solve(div)
             pseu_n = sp_q.pin_zero_mode(pseu_n)
-            velx_n = velx_n - sp_u.from_ortho(sp_q.gradient(pseu_n, (1, 0), scale))
-            vely_n = vely_n - sp_v.from_ortho(sp_q.gradient(pseu_n, (0, 1), scale))
+            if proj_grad is not None:
+                gx0, gx1, gy0, gy1 = proj_grad
+                pax = pseu_n.ndim - 2
+                velx_n = velx_n - gx1.apply(gx0.apply(pseu_n, pax), pax + 1) / scale[0]
+                vely_n = vely_n - gy1.apply(gy0.apply(pseu_n, pax), pax + 1) / scale[1]
+            else:
+                velx_n = velx_n - sp_u.from_ortho(sp_q.gradient(pseu_n, (1, 0), scale))
+                vely_n = vely_n - sp_v.from_ortho(sp_q.gradient(pseu_n, (0, 1), scale))
             # adjoint pressure update: pres_adj += pseu/dt
             # (steady_adjoint_eq.rs:226-236)
             pres_adj_n = state.pres_adj + sp_q.to_ortho(pseu_n) / dt
