@@ -109,3 +109,32 @@ def test_backward_fast_matches_backward():
     np.testing.assert_array_equal(
         np.asarray(sep.backward_fast(vhat)), np.asarray(sep.backward(vhat))
     )
+
+
+def test_fused_projection_gradient_helper():
+    """bases.fused_projection_gradient: matmul-only gating, periodic -> None,
+    value-keyed dedup (square grids share operators), and numerical equality
+    with the unfused from_ortho(gradient(.)) chain."""
+    from rustpde_mpi_tpu.bases import fused_projection_gradient
+
+    q = rp.Space2(rp.cheb_neumann(33), rp.cheb_neumann(33), method="matmul")
+    u = rp.Space2(rp.cheb_dirichlet(33), rp.cheb_dirichlet(33), method="matmul")
+    gx = fused_projection_gradient(u, q, (1, 0))
+    gy = fused_projection_gradient(u, q, (0, 1))
+    assert gx and gy
+    # square grid: the order-0 cast of gy and gx share one cached operator
+    assert gx[1] is gy[0]
+    rng = np.random.default_rng(11)
+    vhat = q.forward(rng.standard_normal(q.shape_physical))
+    ax = vhat.ndim - 2
+    got = np.asarray(gx[1].apply(gx[0].apply(vhat, ax), ax + 1))
+    want = np.asarray(u.from_ortho(q.gradient(vhat, (1, 0), None)))
+    np.testing.assert_allclose(got, want, atol=1e-11)
+    # fft-method spaces (the recurrence path) are not fused
+    q_fft = rp.Space2(rp.cheb_neumann(17), rp.cheb_neumann(17), method="fft")
+    u_fft = rp.Space2(rp.cheb_dirichlet(17), rp.cheb_dirichlet(17), method="fft")
+    assert fused_projection_gradient(u_fft, q_fft, (1, 0)) is None
+    # periodic axes (diagonal Fourier gradient) are not fused either
+    q_per = rp.Space2(rp.fourier_r2c(16), rp.cheb_neumann(17))
+    u_per = rp.Space2(rp.fourier_r2c(16), rp.cheb_dirichlet(17))
+    assert fused_projection_gradient(u_per, q_per, (1, 0)) is None
