@@ -69,6 +69,30 @@ DEFAULT_CONFIGS = [
 # (VERDICT r3 weak #2); the rest rotate least-recently-measured first
 PINNED = ("rbc1025", "rbc1025_f64", "rbc2049")
 
+# shared by the live payload (main) and the degraded payload (_emit_degraded)
+# so the two lines cannot drift apart in the driver's record
+METRIC_NAMES = {
+    "rbc1025": "2D RBC confined 1025x1025 Ra=1e9",
+    "rbc1025_f64": "2D RBC confined 1025x1025 Ra=1e9",
+    "rbc2049": "2D RBC confined 2049x2049 Ra=1e9",
+    "rbc2049_f64": "2D RBC confined 2049x2049 Ra=1e9",
+    "rbc129": "2D RBC confined 129x129 Ra=1e7",
+    "rbc129_f64": "2D RBC confined 129x129 Ra=1e7",
+    "periodic": "2D RBC periodic 128x65 Ra=1e6",
+    "poisson1025": "Poisson standalone 1025x1025",
+    "poisson1025_f64": "Poisson standalone 1025x1025",
+    "sh2048": "Swift-Hohenberg 2048x2048",
+}
+PRIMARY = "rbc1025"
+
+
+def _metric_string(primary_name, unit, x64, platform, stale_note=""):
+    return (
+        f"{'timesteps' if unit == 'steps/s' else 'solves'}/sec, "
+        f"{METRIC_NAMES.get(primary_name, primary_name)} "
+        f"({'f64' if x64 else 'f32'}, {platform}{stale_note})"
+    )
+
 
 def bench_navier(nx, ny, ra, dt, steps, periodic=False, x64=None, shadow_path=None):
     """Model step rate (slope-timed; see profiling.benchmark_steps).
@@ -162,9 +186,188 @@ def bench_sh(nx, steps=128):
     return res
 
 
+def _read_prev():
+    """(platform, results) from BENCH_FULL.json, (None, {}) if absent/corrupt
+    — the single reader shared by the degraded emitter, the cpu-fallback
+    guard, and main()'s merge logic."""
+    try:
+        with open(os.path.join(_REPO, "BENCH_FULL.json")) as f:
+            prev = json.load(f)
+        results = prev.get("results")
+        if isinstance(results, dict):
+            return prev.get("platform"), results
+    except (OSError, ValueError):
+        pass
+    return None, {}
+
+
+def _emit_degraded(reason: str, detail: str = "") -> int:
+    """Emit the final JSON line from the last recorded matrix when the TPU
+    backend is unavailable (VERDICT r4 weak #2: an outage must degrade the
+    record, not blank it).  Every config is marked stale; the payload carries
+    an explicit ``tpu_unavailable`` flag so the driver's record stays
+    parseable and honest."""
+    platform, prev_results = _read_prev()
+    primary = prev_results.get(PRIMARY, {})
+    value = primary.get("steps_per_sec", 0.0) or 0.0
+    payload = {
+        "metric": _metric_string(
+            PRIMARY,
+            "steps/s",
+            False,
+            platform or "unknown",
+            "; STALE — TPU backend unavailable",
+        ),
+        "value": round(float(value), 3),
+        "unit": "steps/s",
+        "vs_baseline": round(float(value) / CPU_BASELINE_STEPS_PER_SEC, 2),
+        "tpu_unavailable": True,
+        "degraded_reason": reason,
+        "degraded_detail": detail[-400:],
+        "shadow_drift_f32_vs_f64": {"evaluated": False, "reason": reason},
+        "configs": {
+            k: dict(v, stale=True)
+            for k, v in prev_results.items()
+            if isinstance(v, dict)
+        },
+    }
+    print(json.dumps(payload))
+    return 0
+
+
+# connection-shaped failure signatures ONLY: a crash whose traceback merely
+# *mentions* the backend (device OOM, a shape bug raised through the plugin)
+# must stay red — these markers are the strings a dead/unreachable relay
+# produces, not strings any on-device failure would
+_OUTAGE_MARKERS = (
+    "UNAVAILABLE",
+    "DEADLINE_EXCEEDED",
+    "failed to connect",
+    "Connection refused",
+    "Connection reset",
+    "Unable to initialize backend",
+    "not in the list of known backends",
+)
+
+
+def _find_payload_line(text: str) -> str | None:
+    """Last line of ``text`` that parses as a payload dict (has "metric")."""
+    for line in reversed((text or "").strip().splitlines()):
+        try:
+            parsed = json.loads(line)
+        except ValueError:
+            continue
+        if isinstance(parsed, dict) and "metric" in parsed:
+            return line
+    return None
+
+
+def _supervise() -> int:
+    """Run the bench matrix in a child process behind a backend probe and a
+    wall timeout, so a relay outage — whether the backend init *raises* (the
+    r4 bench failure) or *hangs* (the r4 dryrun failure) — still yields one
+    parseable JSON line with rc=0 instead of a traceback or a driver
+    timeout."""
+    import subprocess
+
+    probe_timeout = float(os.environ.get("RUSTPDE_BENCH_PROBE_TIMEOUT_S", "150"))
+    try:
+        # honor an explicit JAX_PLATFORMS=cpu (sitecustomize force-registers
+        # the axon platform programmatically, so the env var alone is not
+        # enough — same dance as tests/conftest.py)
+        probe = subprocess.run(
+            [
+                sys.executable,
+                "-c",
+                "import os, jax; "
+                "os.environ.get('JAX_PLATFORMS') == 'cpu' and "
+                "jax.config.update('jax_platforms', 'cpu'); "
+                "print('PLATFORM:' + jax.devices()[0].platform)",
+            ],
+            capture_output=True,
+            text=True,
+            timeout=probe_timeout,
+            cwd=_REPO,
+        )
+    except subprocess.TimeoutExpired:
+        return _emit_degraded(
+            "tpu_backend_probe_hang",
+            f"jax.devices() did not return within {probe_timeout:.0f}s "
+            "(axon relay outage: backend init hangs instead of raising)",
+        )
+    if probe.returncode != 0 or "PLATFORM:" not in probe.stdout:
+        return _emit_degraded(
+            "tpu_backend_init_failed", (probe.stderr or probe.stdout).strip()
+        )
+    platform = probe.stdout.strip().splitlines()[-1].split("PLATFORM:")[-1]
+    # guard against a *silent* CPU fallback (TPU plugin init failing
+    # non-fatally): a cpu-platform run must never clobber a recorded
+    # TPU matrix — main() keys prev_results on the platform, so letting it
+    # proceed would erase the record _emit_degraded depends on
+    if platform == "cpu" and os.environ.get("RUSTPDE_BENCH_ALLOW_CPU") != "1":
+        prev_platform, _ = _read_prev()
+        if prev_platform not in (None, "cpu"):
+            return _emit_degraded(
+                "tpu_backend_fell_back_to_cpu",
+                f"probe reports platform=cpu but the recorded matrix is "
+                f"{prev_platform}; set RUSTPDE_BENCH_ALLOW_CPU=1 to bench "
+                "on CPU anyway",
+            )
+
+    budget = float(os.environ.get("RUSTPDE_BENCH_BUDGET_S", "560"))
+    slack = float(os.environ.get("RUSTPDE_BENCH_SLACK_S", "420"))
+    env = dict(os.environ, RUSTPDE_BENCH_CHILD="1")
+    try:
+        child = subprocess.run(
+            [sys.executable, os.path.abspath(__file__)],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE,
+            text=True,
+            timeout=budget + slack,
+            env=env,
+            cwd=_REPO,
+        )
+        child_out, child_err, child_rc = child.stdout, child.stderr, child.returncode
+    except subprocess.TimeoutExpired as exc:
+        out, err = exc.stdout, exc.stderr
+        if isinstance(out, bytes):
+            out = out.decode(errors="replace")
+        if isinstance(err, bytes):
+            err = err.decode(errors="replace")
+        sys.stderr.write(err or "")
+        # a fresh payload the child printed before hanging (e.g. in TPU-client
+        # teardown through a dead relay) beats a stale degraded line
+        line = _find_payload_line(out)
+        if line is not None:
+            print(line)
+            return 0
+        return _emit_degraded(
+            "bench_timeout",
+            f"matrix run exceeded budget+slack ({budget + slack:.0f}s); "
+            "mid-run relay hang suspected",
+        )
+    sys.stderr.write(child_err or "")
+    # pass a valid payload line through verbatim, preserving the child's rc
+    # (a genuine gate failure must stay red)
+    line = _find_payload_line(child_out)
+    if line is not None:
+        print(line)
+        return child_rc
+    # child died without emitting the line: outage-shaped tracebacks (which
+    # land on stderr) degrade to rc=0, anything else stays red (but parseable)
+    detail = ((child_out or "") + "\n" + (child_err or "")).strip()
+    outage = any(m in detail for m in _OUTAGE_MARKERS)
+    rc = _emit_degraded(
+        "bench_crashed_outage" if outage else "bench_crashed", detail
+    )
+    return rc if outage else (child_rc or 1)
+
+
 def main() -> int:
     import jax
 
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        jax.config.update("jax_platforms", "cpu")
     platform = jax.devices()[0].platform
     sel = os.environ.get("RUSTPDE_BENCH_CONFIGS", "all")
     names = DEFAULT_CONFIGS if sel == "all" else [s.strip() for s in sel.split(",")]
@@ -182,14 +385,9 @@ def main() -> int:
     budget = float(os.environ.get("RUSTPDE_BENCH_BUDGET_S", "560"))
     bench_start = time.perf_counter()
 
-    prev_results: dict = {}
-    try:
-        with open("BENCH_FULL.json") as f:
-            prev = json.load(f)
-        if prev.get("platform") == platform and isinstance(prev.get("results"), dict):
-            prev_results = prev["results"]
-    except (OSError, ValueError):
-        pass
+    prev_platform, prev_results = _read_prev()
+    if prev_platform != platform:
+        prev_results = {}
     seq = 1 + max(
         (v.get("seq", 0) for v in prev_results.values() if isinstance(v, dict)),
         default=0,
@@ -305,18 +503,6 @@ def main() -> int:
     )
     mfu = primary.get("mfu", {}).get("mfu")
 
-    metric_names = {
-        "rbc1025": "2D RBC confined 1025x1025 Ra=1e9",
-        "rbc1025_f64": "2D RBC confined 1025x1025 Ra=1e9",
-        "rbc2049": "2D RBC confined 2049x2049 Ra=1e9",
-        "rbc2049_f64": "2D RBC confined 2049x2049 Ra=1e9",
-        "rbc129": "2D RBC confined 129x129 Ra=1e7",
-        "rbc129_f64": "2D RBC confined 129x129 Ra=1e7",
-        "periodic": "2D RBC periodic 128x65 Ra=1e6",
-        "poisson1025": "Poisson standalone 1025x1025",
-        "poisson1025_f64": "Poisson standalone 1025x1025",
-        "sh2048": "Swift-Hohenberg 2048x2048",
-    }
     # precision tag of the run the metric actually reports (the f64 config
     # runs in its own X64=1 subprocess regardless of this process's env)
     x64 = os.environ.get("RUSTPDE_X64") == "1" or (
@@ -385,11 +571,7 @@ def main() -> int:
         ok = ok and shadow["passed"]
 
     payload = {
-        "metric": (
-            f"{'timesteps' if unit == 'steps/s' else 'solves'}/sec, "
-            f"{metric_names.get(primary_name, primary_name)} "
-            f"({'f64' if x64 else 'f32'}, {platform})"
-        ),
+        "metric": _metric_string(primary_name, unit, x64, platform),
         "value": round(value, 3),
         "unit": unit,
         "vs_baseline": round(vs, 2),
@@ -405,11 +587,15 @@ def main() -> int:
     # versa); per-entry 'seq' marks how fresh each number is
     record: dict = {"platform": platform, "results": dict(prev_results)}
     record["results"].update(sanitized)
-    with open("BENCH_FULL.json", "w") as f:
+    with open(os.path.join(_REPO, "BENCH_FULL.json"), "w") as f:
         json.dump(record, f, indent=1, default=str)
     print(json.dumps(payload))
     return 0 if ok and value > 0 else 1
 
 
 if __name__ == "__main__":
-    sys.exit(main())
+    # the supervisor probes the backend and guards the matrix run with a
+    # timeout; the child (RUSTPDE_BENCH_CHILD=1) does the actual benching
+    if os.environ.get("RUSTPDE_BENCH_CHILD") == "1":
+        sys.exit(main())
+    sys.exit(_supervise())

@@ -114,6 +114,9 @@ class Base:
         self._diff_cache: dict = {}
         self._grad_cache: dict = {}
         self._grad_dev_cache: dict = {}
+        # fused projection-gradient device operators (fused_projection_gradient):
+        # living on the instance ties their lifetime to the weak _BASE_CACHE
+        self._proj_grad_cache: dict = {}
         if kind in (BaseKind.CHEBYSHEV, BaseKind.FOURIER_C2C):
             self.m = n
         elif kind == BaseKind.FOURIER_R2C:
@@ -973,9 +976,6 @@ class Space2:
         return self.spectral_from_natural(vhat_c)
 
 
-_PROJ_GRAD_CACHE: dict = {}
-
-
 def fused_projection_gradient(space_out: "Space2", space_in: "Space2", deriv):
     """Per-axis cross-space operators applying
     ``space_out.from_ortho(space_in.gradient(., deriv))`` as ONE GEMM per
@@ -988,7 +988,10 @@ def fused_projection_gradient(space_out: "Space2", space_in: "Space2", deriv):
 
     Deduplicated by VALUE key (base kinds + sizes + order + sep flags —
     operator matrices depend on nothing else), so e.g. the d/dx and d/dy
-    corrections of a square grid share their device constants."""
+    corrections of a square grid share their device constants.  The cache
+    dict lives ON the output-axis Base instance (which _BASE_CACHE holds
+    only weakly), so the device matrices are freed with their bases instead
+    of accumulating module-globally across many model sizes (ADVICE r4)."""
     bases_all = tuple(space_in.bases) + tuple(space_out.bases)
     if any(b.kind.is_periodic for b in bases_all):
         return None
@@ -997,11 +1000,9 @@ def fused_projection_gradient(space_out: "Space2", space_in: "Space2", deriv):
     mats = []
     for ax, order in enumerate(deriv):
         b_out, b_in = space_out.bases[ax], space_in.bases[ax]
-        key = (
-            b_out.kind, b_out.n, b_in.kind, b_in.n, order,
-            space_in.sep[ax], space_out.sep[ax],
-        )
-        fm = _PROJ_GRAD_CACHE.get(key)
+        cache = b_out._proj_grad_cache
+        key = (b_in.kind, b_in.n, order, space_in.sep[ax], space_out.sep[ax])
+        fm = cache.get(key)
         if fm is None:
             fm = FoldedMatrix(
                 b_out.projection @ b_in.gradient_matrix(order),
@@ -1009,7 +1010,7 @@ def fused_projection_gradient(space_out: "Space2", space_in: "Space2", deriv):
                 sep_in=space_in.sep[ax],
                 sep_out=space_out.sep[ax],
             )
-            _PROJ_GRAD_CACHE[key] = fm
+            cache[key] = fm
         mats.append(fm)
     return tuple(mats)
 

@@ -195,9 +195,29 @@ class Navier2D(Integrate):
         self._step = lambda s: step_jit(self._step_consts, s)
 
         def step_n(consts, state, n: int):
-            return jax.lax.scan(
-                lambda c, _: (step_cc(consts, c), None), state, None, length=n
-            )[0]
+            """n scanned steps with in-chunk divergence early-exit: an
+            is-finite flag rides the carry, and once the flow is NaN the
+            remaining iterations take the identity branch of a ``lax.cond``
+            — the device stops paying for GEMMs mid-chunk instead of burning
+            the rest of a minutes-long chunk on NaNs (the reference checks
+            ``pde.exit()`` every step, /root/reference/src/lib.rs:187-219).
+            Returns ``(state, steps_done)``; a NaN temp field infects velx
+            within one step (buoyancy) and vice versa (convection), so one
+            reduction over temp per step is a complete detector."""
+
+            def advance(carry):
+                st, _, done = carry
+                st2 = step_cc(consts, st)
+                ok2 = jnp.isfinite(jnp.sum(st2.temp))
+                return st2, ok2, done + 1
+
+            def body(carry, _):
+                carry2 = jax.lax.cond(carry[1], advance, lambda c: c, carry)
+                return carry2, None
+
+            init = (state, jnp.asarray(True), jnp.asarray(0, jnp.int32))
+            (final, _, done), _ = jax.lax.scan(body, init, None, length=n)
+            return final, done
 
         step_n_jit = jax.jit(step_n, static_argnames=("n",))
         self._step_n = lambda s, n: step_n_jit(self._step_consts, s, n=n)
@@ -529,11 +549,17 @@ class Navier2D(Integrate):
 
     def update_n(self, n: int) -> None:
         """Advance n steps on the device via scanned power-of-two chunks
-        (utils/jit.run_scanned)."""
+        (utils/jit.run_scanned).  Dispatches stay asynchronous (no per-bucket
+        host sync — through the relay a sync costs ~110 ms); on divergence
+        the in-scan early exit freezes the state, ``exit()`` reports it at
+        the next chunk boundary, and ``self.time`` deliberately counts the
+        scheduled steps (the post-NaN run is over either way)."""
         from ..utils.jit import run_scanned
 
         with self._scope():
-            self.state = run_scanned(self._step_n, self.state, n)
+            self.state = run_scanned(
+                lambda s, k: self._step_n(s, k)[0], self.state, n
+            )
         self.time += n * self.dt
 
     def get_time(self) -> float:

@@ -88,6 +88,11 @@ def _real_eig_desc(x: np.ndarray):
     return lam[order], q[:, order]
 
 
+# bump when the modal decomposition code or the stored (lam, fwd, q)
+# semantics change — the disk-cache key hashes only the ingredient matrices
+_MODAL_CACHE_VERSION = "v1"
+
+
 def _axis_modal_data(space: Space2, axis: int, ci: float, sign: float):
     """Modal diagonalization of one axis of the preconditioned operator.
 
@@ -106,9 +111,11 @@ def _axis_modal_data(space: Space2, axis: int, ci: float, sign: float):
     # nonsymmetric parity-block eigendecompositions dominate build time at
     # the flagship sizes (~tens of seconds at 2049); exact f64 npz
     # round-trips, keyed by the INGREDIENT CONTENT (cheap O(n^2) hash of the
-    # matrices actually decomposed — a code change to the preconditioner or
-    # eig ordering invalidates entries) plus ci/sign.  Gated to n >= 512:
-    # below that the eig costs less than the IO.
+    # matrices actually decomposed) plus ci/sign.  The content hash does NOT
+    # see this function's code: the _MODAL_CACHE_VERSION salt below must be
+    # bumped whenever the decomposition algorithm or the stored (lam, fwd,
+    # q) semantics change (ADVICE r4).  Gated to n >= 512: below that the
+    # eig costs less than the IO.
     cache_path = None
     if base.n >= 512:
         import hashlib
@@ -118,7 +125,8 @@ def _axis_modal_data(space: Space2, axis: int, ci: float, sign: float):
             h.update(np.ascontiguousarray(m).tobytes())
         cache_path = os.path.join(
             config.host_cache_dir(),
-            f"modal_{base.kind.value}_{base.n}_{float(ci):.17g}_{sign:g}_{h.hexdigest()}.npz",
+            f"modal_{_MODAL_CACHE_VERSION}_{base.kind.value}_{base.n}_"
+            f"{float(ci):.17g}_{sign:g}_{h.hexdigest()}.npz",
         )
         try:
             with np.load(cache_path) as z:

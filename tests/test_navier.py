@@ -113,3 +113,27 @@ def test_exit_is_false_for_healthy_run():
     model = Navier2D.new_confined(17, 17, 1e4, 1.0, 0.01, 1.0, "rbc")
     model.update()
     assert model.exit() is False
+
+
+def test_nan_divergence_early_exit_in_chunk():
+    """In-chunk failure detection (reference: per-step ``pde.exit()``,
+    /root/reference/src/lib.rs:187-219): once the flow is NaN the scanned
+    chunk stops stepping on device — the step counter threaded through the
+    scan carry freezes at the first NaN step instead of burning the chunk."""
+    import jax.numpy as jnp
+
+    model = Navier2D.new_confined(17, 17, 1e4, 1.0, 0.01, 1.0, "rbc")
+    # healthy state: all 64 scheduled steps execute
+    _, done = model._step_n(model.state, 64)
+    assert int(done) == 64
+    # poison one temperature mode: the first step produces a NaN field, the
+    # remaining 63 iterations take the identity branch
+    bad = model.state._replace(
+        temp=model.state.temp.at[(0,) * model.state.temp.ndim].set(jnp.nan)
+    )
+    frozen, done = model._step_n(bad, 64)
+    assert int(done) == 1
+    # the driver-visible criterion fires at the next boundary
+    model.state = frozen
+    model._obs_cache = None
+    assert model.exit() is True
