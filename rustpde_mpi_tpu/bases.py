@@ -267,7 +267,14 @@ class Base:
             return cache[key]
         synth_prec = None
         if fast and not config.X64:
-            env = os.environ.get("RUSTPDE_SYNTH_PRECISION", "high")
+            if base_key == "fwd_cut":
+                # the dealiased convection FORWARD has its own knob, default
+                # OFF (highest): unlike the syntheses it writes the solve
+                # rhs directly, so the downgrade ships only once measured
+                # on-chip + shadow-gated (RUSTPDE_FWD_PRECISION=high to A/B)
+                env = os.environ.get("RUSTPDE_FWD_PRECISION", "highest")
+            else:
+                env = os.environ.get("RUSTPDE_SYNTH_PRECISION", "high")
             synth_prec = None if env in ("", "highest") else env
         if fast and synth_prec is None:
             # no downgrade requested (f64, or RUSTPDE_SYNTH_PRECISION=highest):
@@ -844,18 +851,21 @@ class Space2:
         )
         return constrain(out, PHYS)
 
-    def forward_dealiased(self, v):
+    def forward_dealiased(self, v, fast: bool = False):
         """Physical -> spectral with the 2/3-rule mask applied, in one fused
         form: on all-sep spaces the dead rows are dropped from the forward
         GEMMs (2/3 flops, no mask pass).  Callers keep a ``forward() * mask``
-        fallback for other configurations."""
+        fallback for other configurations.  ``fast=True`` selects the 3-pass
+        variant gated by RUSTPDE_FWD_PRECISION (default off — see
+        Base._sep_dev)."""
         from .parallel.mesh import PHYS, SPEC, constrain
 
         if not all(self.sep):
             raise ValueError("forward_dealiased requires an all-sep space")
         ax = self._batch_ax(v)
-        out = self.bases[1]._sep_dev("fwd_cut").apply(constrain(v, PHYS), ax + 1)
-        out = self.bases[0]._sep_dev("fwd_cut").apply(constrain(out, SPEC), ax)
+        key = ("fwd_cut", "fast") if fast else "fwd_cut"
+        out = self.bases[1]._sep_dev(key).apply(constrain(v, PHYS), ax + 1)
+        out = self.bases[0]._sep_dev(key).apply(constrain(out, SPEC), ax)
         return constrain(out, SPEC)
 
     def backward_gradient(self, vhat, deriv, scale=None, fast=False):

@@ -428,8 +428,9 @@ class Navier2D(Integrate):
             if with_bc:
                 total = total + ux * tb_dx + uy * tb_dy
             if all(sp_f.sep):
-                # dealias folded into the forward GEMMs (dead rows dropped)
-                return sp_f.forward_dealiased(total)
+                # dealias folded into the forward GEMMs (dead rows dropped);
+                # fast=True additionally honors RUSTPDE_FWD_PRECISION
+                return sp_f.forward_dealiased(total, fast=True)
             return sp_f.forward(total) * mask
 
         def step(state: NavierState) -> NavierState:
@@ -520,17 +521,21 @@ class Navier2D(Integrate):
 
         def observables(state: NavierState):
             that = sp_t.to_ortho(state.temp) + tb
+            # physical dT/dy, computed ONCE via the fused synthesis-of-
+            # derivative chain (backward_ortho(gradient(.)) collapsed to one
+            # GEMM per axis on sep spaces) and shared by the plate-flux Nu
+            # and the volume Nuvol — the unfused form ran the gradient and
+            # two separate backward_orthos (VERDICT r4 next #7)
+            dtdy_p = sp_f.backward_gradient(that, (0, 1), None)
             # Nu: plate heat flux <-2/sy * dT/dy>_x averaged over both plates
-            dtdz = sp_f.gradient(that, (0, 1), None) * (-2.0 / scale[1])
-            x_avg = avg_x(sp_f.backward_ortho(dtdz))
+            x_avg = avg_x(dtdy_p) * (-2.0 / scale[1])
             nu_plate = 0.5 * (x_avg[0] + x_avg[-1])
             # Nuvol: <2 sy (uy T / ka - dT/dy / sy)>_V
             temp_p = sp_f.backward_ortho(that)
             uy = sp_v.backward(state.vely)
-            dtdz_p = sp_f.backward_ortho(sp_f.gradient(that, (0, 1), None)) / (
-                -scale[1]
+            nu_vol = avg(
+                (dtdy_p / (-scale[1]) + uy * temp_p / ka) * 2.0 * scale[1]
             )
-            nu_vol = avg((dtdz_p + uy * temp_p / ka) * 2.0 * scale[1])
             # Re: <sqrt(ux^2+uy^2) * 2 sy / nu>_V
             ux = sp_u.backward(state.velx)
             re = avg(jnp.sqrt(ux**2 + uy**2) * 2.0 * scale[1] / nu)

@@ -156,6 +156,11 @@ class _AnalysisFold:
 
     kind = "analysis"
 
+    #: optional per-impl matmul precision override (None = session default);
+    #: set by FoldedMatrix for the dealiased-forward 3-pass mode
+    #: (RUSTPDE_FWD_PRECISION) — same hook as _SynthesisSep.precision
+    precision = None
+
     def __init__(self, mat: np.ndarray):
         r, n = mat.shape
         h = n // 2
@@ -186,8 +191,8 @@ class _AnalysisFold:
         v = x[:h] - xr[:h]
         if n % 2 == 1:
             u = jnp.concatenate([u, x[h : h + 1]], axis=0)
-        y_e = jnp.tensordot(m_e, u, axes=([1], [0]))
-        y_o = jnp.tensordot(m_o, v, axes=([1], [0]))
+        y_e = jnp.tensordot(m_e, u, axes=([1], [0]), precision=self.precision)
+        y_o = jnp.tensordot(m_o, v, axes=([1], [0]), precision=self.precision)
         return _unmove(self._combine(y_e, y_o), axis)
 
 

@@ -138,3 +138,28 @@ def test_fused_projection_gradient_helper():
     q_per = rp.Space2(rp.fourier_r2c(16), rp.cheb_neumann(17))
     u_per = rp.Space2(rp.fourier_r2c(16), rp.cheb_dirichlet(17))
     assert fused_projection_gradient(u_per, q_per, (1, 0)) is None
+
+
+def test_fwd_cut_fast_key_plumbing(monkeypatch):
+    """("fwd_cut","fast"): aliases the exact entry when RUSTPDE_FWD_PRECISION
+    is unset/highest (default OFF until measured on-chip), and builds a
+    distinct impl carrying the precision override when set to high."""
+    from rustpde_mpi_tpu import config as cfg
+
+    sep = rp.Space2(rp.cheb_dirichlet(33), rp.cheb_neumann(33), sep=True, method="matmul")
+    b = sep.bases[0]
+    monkeypatch.delenv("RUSTPDE_FWD_PRECISION", raising=False)
+    assert b._sep_dev(("fwd_cut", "fast")) is b._sep_dev("fwd_cut")
+    if cfg.X64:
+        return  # f64 never downgrades; alias behavior above is the contract
+    b2 = rp.cheb_dirichlet(35)
+    monkeypatch.setenv("RUSTPDE_FWD_PRECISION", "high")
+    fast = b2._sep_dev(("fwd_cut", "fast"))
+    assert fast is not b2._sep_dev("fwd_cut")
+    assert fast._impl.precision == "high"
+    # fast forward == exact forward on CPU (precision hint is a no-op there)
+    rng = np.random.default_rng(1)
+    v = rng.standard_normal(sep.shape_physical)
+    got = np.asarray(sep.forward_dealiased(v, fast=False))
+    want = np.asarray(sep.forward(v)) * sep.dealias_mask()
+    np.testing.assert_allclose(got, want, atol=1e-13)
