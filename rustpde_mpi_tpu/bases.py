@@ -266,6 +266,7 @@ class Base:
         if key in cache:
             return cache[key]
         synth_prec = None
+        cast = None
         if fast and not config.X64:
             if base_key == "fwd_cut":
                 # the dealiased convection FORWARD has its own knob, default
@@ -276,26 +277,37 @@ class Base:
             else:
                 env = os.environ.get("RUSTPDE_SYNTH_PRECISION", "high")
             synth_prec = None if env in ("", "highest") else env
-        if fast and synth_prec is None:
-            # no downgrade requested (f64, or RUSTPDE_SYNTH_PRECISION=highest):
-            # the fast key is byte-identical to the base entry — alias it
-            # instead of re-detecting and double-placing the device matrix
+        elif fast and config.X64 and os.environ.get("RUSTPDE_F64_HYBRID") == "1":
+            # f64-hybrid (SURVEY S7 / VERDICT r4 next #3b): the convection
+            # transforms — the step's fast keys, nothing else — run as f32
+            # GEMMs (device matrices stored f32, inputs cast in, outputs cast
+            # back to f64), dodging the ~16x f64 MXU emulation on the
+            # dominant transform flops while every solve, analysis forward,
+            # observable and IO stays full f64.  Opt-in; validated against
+            # the 129^2 parity trajectory + shadow gate before any default
+            # flip.
+            cast = np.float32
+        if fast and synth_prec is None and cast is None:
+            # no downgrade requested (f64 without hybrid, or
+            # RUSTPDE_*_PRECISION=highest): the fast key is byte-identical to
+            # the base entry — alias it instead of re-detecting and
+            # double-placing the device matrix
             cache[key] = self._sep_dev(base_key)
             return cache[key]
         if base_key == "fwd":
             fm = FoldedMatrix(
-                self.projection @ chb.analysis_matrix(self.n), _dev, sep_out=True
+                self.projection @ chb.analysis_matrix(self.n), _dev, sep_out=True, cast=cast
             )
         elif base_key == "bwd":
             fm = FoldedMatrix(
-                chb.synthesis_matrix(self.n) @ self.stencil, _dev, sep_in=True
+                chb.synthesis_matrix(self.n) @ self.stencil, _dev, sep_in=True, cast=cast
             )
         elif base_key == "stencil":
-            fm = FoldedMatrix(self.stencil, _dev, sep_in=True, sep_out=True)
+            fm = FoldedMatrix(self.stencil, _dev, sep_in=True, sep_out=True, cast=cast)
         elif base_key == "proj":
-            fm = FoldedMatrix(self.projection, _dev, sep_in=True, sep_out=True)
+            fm = FoldedMatrix(self.projection, _dev, sep_in=True, sep_out=True, cast=cast)
         elif base_key == "synthesis":
-            fm = FoldedMatrix(chb.synthesis_matrix(self.n), _dev, sep_in=True)
+            fm = FoldedMatrix(chb.synthesis_matrix(self.n), _dev, sep_in=True, cast=cast)
         elif base_key == "fwd_cut":
             # forward with the 2/3-rule dealias folded in: the zeroed output
             # modes are dropped from the GEMM (keep_rows), so the dealiased
@@ -305,6 +317,7 @@ class Base:
                 _dev,
                 sep_out=True,
                 keep_rows=self.m * 2 // 3,
+                cast=cast,
             )
         elif isinstance(base_key, tuple) and base_key[0] == "bwd_grad":
             # synthesis-of-derivative fusion: physical values of the order-th
@@ -315,10 +328,15 @@ class Base:
                 chb.synthesis_matrix(self.n) @ self.gradient_matrix(base_key[1]),
                 _dev,
                 sep_in=True,
+                cast=cast,
             )
         else:  # ("grad", order)
             fm = FoldedMatrix(
-                self.gradient_matrix(base_key[1]), _dev, sep_in=True, sep_out=True
+                self.gradient_matrix(base_key[1]),
+                _dev,
+                sep_in=True,
+                sep_out=True,
+                cast=cast,
             )
         if synth_prec:
             # only impls that declare the hook honor an override (the
@@ -853,39 +871,68 @@ class Space2:
 
     def forward_dealiased(self, v, fast: bool = False):
         """Physical -> spectral with the 2/3-rule mask applied, in one fused
-        form: on all-sep spaces the dead rows are dropped from the forward
-        GEMMs (2/3 flops, no mask pass).  Callers keep a ``forward() * mask``
-        fallback for other configurations.  ``fast=True`` selects the 3-pass
-        variant gated by RUSTPDE_FWD_PRECISION (default off — see
-        Base._sep_dev)."""
+        form: sep axes drop the dead rows from their forward GEMMs (2/3
+        flops, no mask pass); non-sep axes (e.g. the split-Fourier axis of a
+        periodic space) run their plain forward and get their 1-D cut as a
+        vector multiply.  Callers keep a ``forward() * mask`` fallback for
+        fully non-sep spaces.  ``fast=True`` selects the 3-pass variant
+        gated by RUSTPDE_FWD_PRECISION (default off — see Base._sep_dev)."""
         from .parallel.mesh import PHYS, SPEC, constrain
 
-        if not all(self.sep):
-            raise ValueError("forward_dealiased requires an all-sep space")
+        if not any(self.sep):
+            raise ValueError("forward_dealiased requires at least one sep axis")
         ax = self._batch_ax(v)
         key = ("fwd_cut", "fast") if fast else "fwd_cut"
-        out = self.bases[1]._sep_dev(key).apply(constrain(v, PHYS), ax + 1)
-        out = self.bases[0]._sep_dev(key).apply(constrain(out, SPEC), ax)
+        out = constrain(v, PHYS)
+        if self.sep[1]:
+            out = self.bases[1]._sep_dev(key).apply(out, ax + 1)
+        else:
+            out = self.bases[1].forward(out, ax + 1, self._axis_method(1))
+        out = constrain(out, SPEC)
+        if self.sep[0]:
+            out = self.bases[0]._sep_dev(key).apply(out, ax)
+        else:
+            out = self.bases[0].forward(out, ax, self._axis_method(0))
+        for axis in (0, 1):
+            if not self.sep[axis]:
+                cut = self.bases[axis].dealias_cut()
+                shape = [1] * out.ndim
+                shape[ax + axis] = cut.shape[0]
+                out = out * jnp.asarray(
+                    cut.reshape(shape), dtype=config.real_dtype()
+                )
         return constrain(out, SPEC)
 
     def backward_gradient(self, vhat, deriv, scale=None, fast=False):
         """Physical values of d^deriv[0]/dx d^deriv[1]/dy — the fused
-        ``backward_ortho(gradient(...))``: on all-sep spaces each axis is ONE
+        ``backward_ortho(gradient(...))``: each sep axis is ONE
         synthesis-of-derivative GEMM (key ("bwd_grad", order); order 0 is the
-        plain fused backward), saving the separate gradient apply per axis.
-        ``fast=True`` selects the 3-pass synthesis variants (DNS convection
-        path only — see Base._sep_dev)."""
+        plain fused backward), saving the separate gradient apply.  Non-sep
+        axes (e.g. the split-Fourier axis of a periodic space) fall back to
+        gradient-then-synthesis on that axis only, so mixed spaces still
+        fuse their Chebyshev axis.  ``fast=True`` selects the 3-pass
+        synthesis variants (DNS convection path only — see Base._sep_dev)."""
         from .parallel.mesh import PHYS, SPEC, constrain
 
-        if not all(self.sep):
+        if not any(self.sep):
             return self.backward_ortho(self.gradient(vhat, deriv, scale))
         ax = self._batch_ax(vhat)
-        keys = [("bwd_grad", d) if d else "bwd" for d in deriv]
-        if fast:
-            keys = [(k, "fast") if isinstance(k, str) else k + ("fast",) for k in keys]
-        out = self.bases[0]._sep_dev(keys[0]).apply(constrain(vhat, SPEC), ax)
-        out = self.bases[1]._sep_dev(keys[1]).apply(constrain(out, PHYS), ax + 1)
-        out = constrain(out, PHYS)
+        out = constrain(vhat, SPEC)
+        for axis in (0, 1):
+            b = self.bases[axis]
+            a = ax + axis
+            if self.sep[axis]:
+                key = ("bwd_grad", deriv[axis]) if deriv[axis] else "bwd"
+                if fast:
+                    key = (key, "fast") if isinstance(key, str) else key + ("fast",)
+                out = b._sep_dev(key).apply(out, a)
+            else:
+                out = b.gradient(out, deriv[axis], a, sep=False)
+                out = b.backward_ortho(out, a, self._axis_method(axis))
+            # pencil flip: the half-transformed intermediate moves to the
+            # physical (y-pencil) layout before the axis-1 apply, as in
+            # backward()/backward_ortho()
+            out = constrain(out, PHYS)
         if scale is not None:
             factor = (scale[0] ** deriv[0]) * (scale[1] ** deriv[1])
             if factor != 1.0:

@@ -147,7 +147,7 @@ class Navier2DLnse(Integrate):
             return sp_f.backward_ortho(space.gradient(vhat, deriv, scale))
 
         def conv(total):
-            if all(sp_f.sep):
+            if any(sp_f.sep):
                 return sp_f.forward_dealiased(total)
             return sp_f.forward(total) * mask
 
@@ -274,7 +274,7 @@ class Navier2DLnse(Integrate):
             return sp_f.backward_ortho(space.gradient(vhat, deriv, scale))
 
         def conv(total):
-            if all(sp_f.sep):
+            if any(sp_f.sep):
                 return sp_f.forward_dealiased(total)
             return sp_f.forward(total) * mask
 

@@ -427,9 +427,10 @@ class Navier2D(Integrate):
             total = ux * dvdx + uy * dvdy
             if with_bc:
                 total = total + ux * tb_dx + uy * tb_dy
-            if all(sp_f.sep):
-                # dealias folded into the forward GEMMs (dead rows dropped);
-                # fast=True additionally honors RUSTPDE_FWD_PRECISION
+            if any(sp_f.sep):
+                # dealias folded into the forward GEMMs (dead rows dropped
+                # on sep axes, vector cut on the rest); fast=True
+                # additionally honors RUSTPDE_FWD_PRECISION
                 return sp_f.forward_dealiased(total, fast=True)
             return sp_f.forward(total) * mask
 

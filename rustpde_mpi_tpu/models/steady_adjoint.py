@@ -176,7 +176,7 @@ class Navier2DAdjoint(Integrate):
             that_full = sp_t.to_ortho(ns.temp) + tb_ortho
 
             def conv(total):
-                if all(sp_f.sep):
+                if any(sp_f.sep):
                     return sp_f.forward_dealiased(total)
                 return sp_f.forward(total) * mask
 

@@ -559,10 +559,28 @@ class FoldedMatrix:
 
     def __init__(
         self, mat: np.ndarray, to_dev, sep_in: bool = False, sep_out: bool = False,
-        keep_rows=None,
+        keep_rows=None, cast=None,
     ):
+        """``cast``: store the device parts in this dtype and run apply()
+        through it (input cast in, output cast back to the input dtype) —
+        the f64-hybrid mode's f32 convection transforms (Base._sep_dev)."""
         self._impl = _detect(np.asarray(mat), sep_in, sep_out, keep_rows)
-        self._dev = self._impl.device_parts(to_dev)
+        self._cast = np.dtype(cast) if cast is not None else None
+        if self._cast is None:
+            place = to_dev
+        else:
+            def place(m, _c=self._cast):
+                import jax
+
+                # cast on the HOST and place directly (bypassing to_dev,
+                # whose astype(config.real_dtype()) would undo the cast):
+                # half the bytes over the wire and no transient f64 device
+                # buffer; ensure_compile_time_eval keeps the constant
+                # concrete under lazy in-trace materialization, like
+                # bases._dev itself
+                with jax.ensure_compile_time_eval():
+                    return jnp.asarray(np.asarray(m).astype(_c))
+        self._dev = self._impl.device_parts(place)
         # drop the host copies — apply() reads only the device parts and the
         # scalar shape metadata (at 2049^2 f64 a retained inverse is ~33 MB);
         # recurse into wrapped impls (_CircBothFold holds an inner fold,
@@ -587,6 +605,9 @@ class FoldedMatrix:
         return self._impl.flops_factor
 
     def apply(self, a, axis: int):
+        if self._cast is not None and a.dtype != self._cast:
+            out = self._impl.apply(self._dev, a.astype(self._cast), axis)
+            return out.astype(a.dtype)
         return self._impl.apply(self._dev, a, axis)
 
 

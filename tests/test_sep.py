@@ -163,3 +163,62 @@ def test_fwd_cut_fast_key_plumbing(monkeypatch):
     got = np.asarray(sep.forward_dealiased(v, fast=False))
     want = np.asarray(sep.forward(v)) * sep.dealias_mask()
     np.testing.assert_allclose(got, want, atol=1e-13)
+
+
+def test_mixed_sep_periodic_space(monkeypatch):
+    """Periodic (split-Fourier x, Chebyshev y) space with the Chebyshev axis
+    sep: the per-axis fused paths — forward_dealiased with a vector cut on
+    the Fourier axis, backward_gradient with the fused chain on the sep axis
+    only — match the unfused forms exactly."""
+    monkeypatch.setenv("RUSTPDE_FORCE_TPU_PATH", "1")
+    sp = rp.Space2(rp.fourier_r2c(16), rp.cheb_dirichlet(17), method="matmul", sep=True)
+    assert sp.sep == (False, True)
+    rng = np.random.default_rng(3)
+    v = rng.standard_normal(sp.shape_physical)
+    got = np.asarray(sp.forward_dealiased(v))
+    want = np.asarray(sp.forward(v)) * sp.dealias_mask()
+    np.testing.assert_allclose(got, want, atol=1e-12)
+    vhat = sp.forward(jnp.asarray(v))
+    for deriv in [(0, 0), (1, 0), (0, 1), (1, 1), (0, 2)]:
+        got = np.asarray(sp.backward_gradient(vhat, deriv, None))
+        want = np.asarray(sp.backward_ortho(sp.gradient(vhat, deriv, None)))
+        np.testing.assert_allclose(
+            got, want, atol=1e-10 * max(1.0, np.abs(want).max()), err_msg=str(deriv)
+        )
+
+
+def test_periodic_model_forced_sep_matches_default():
+    """A periodic Navier model with the Chebyshev axis forced sep
+    (RUSTPDE_SEP=1) reproduces the default-layout trajectory to roundoff —
+    the at-scale periodic layout candidate (VERDICT r4 next #2)."""
+    import json
+    import os
+    import subprocess
+    import sys
+
+    code = (
+        "import jax, json\n"
+        "jax.config.update('jax_platforms', 'cpu')\n"
+        "from rustpde_mpi_tpu import Navier2D\n"
+        "m = Navier2D.new_periodic(16, 17, 1e4, 1.0, 1e-2, 1.0, 'rbc')\n"
+        "import sys; print('sep', m.temp_space.sep, file=sys.stderr)\n"
+        "m.set_velocity(0.1, 2.0, 2.0); m.set_temperature(0.1, 2.0, 2.0)\n"
+        "m.update_n(60)\n"
+        "print(json.dumps(list(m.get_observables())))\n"
+    )
+    obs = {}
+    for sep in ("0", "1"):
+        env = dict(
+            os.environ,
+            RUSTPDE_FORCE_TPU_PATH="1",
+            RUSTPDE_SEP=sep,
+            JAX_PLATFORMS="cpu",
+        )
+        out = subprocess.run(
+            [sys.executable, "-c", code],
+            capture_output=True, text=True, env=env, timeout=600,
+        )
+        assert out.returncode == 0, out.stderr[-2000:]
+        obs[sep] = json.loads(out.stdout.strip().splitlines()[-1])
+    for a, b in zip(obs["0"], obs["1"]):
+        assert abs(a - b) <= 1e-9 * max(1.0, abs(a)), (obs["0"], obs["1"])

@@ -149,3 +149,48 @@ def test_penalization_tpu_path_matches_default(tpu_path, monkeypatch):
     np.testing.assert_allclose(
         tpu_model.get_field("velx"), cpu_model.get_field("velx"), atol=1e-10
     )
+
+
+def test_f64_hybrid_tracks_full_f64():
+    """RUSTPDE_F64_HYBRID=1 (f32 convection transforms feeding f64 solves,
+    SURVEY S7 hybrid): state stays f64 and a 50-step trajectory tracks the
+    all-f64 one at f32-roundoff level.  Subprocesses: the sep-operator cache
+    is keyed per-process by the build-time env."""
+    import json
+    import os
+    import subprocess
+    import sys
+
+    code = (
+        "import os, jax\n"
+        "jax.config.update('jax_platforms', 'cpu')\n"
+        "import json\n"
+        "from rustpde_mpi_tpu import Navier2D\n"
+        "m = Navier2D.new_confined(33, 33, 1e5, 1.0, 5e-3, 1.0, 'rbc')\n"
+        "assert all(m.temp_space.sep)\n"
+        "assert str(m.state.temp.dtype) == 'float64'\n"
+        "m.set_velocity(0.1, 2.0, 2.0); m.set_temperature(0.1, 2.0, 2.0)\n"
+        "m.update_n(50)\n"
+        "assert str(m.state.temp.dtype) == 'float64'\n"
+        "print(json.dumps(list(m.get_observables())))\n"
+    )
+    obs = {}
+    for hybrid in ("0", "1"):
+        env = dict(
+            os.environ,
+            RUSTPDE_X64="1",
+            RUSTPDE_FORCE_TPU_PATH="1",
+            RUSTPDE_F64_HYBRID=hybrid,
+            JAX_PLATFORMS="cpu",
+        )
+        out = subprocess.run(
+            [sys.executable, "-c", code],
+            capture_output=True, text=True, env=env, timeout=600,
+        )
+        assert out.returncode == 0, out.stderr[-2000:]
+        obs[hybrid] = json.loads(out.stdout.strip().splitlines()[-1])
+    nu64, nuh = obs["0"][0], obs["1"][0]
+    assert abs(nuh - nu64) / abs(nu64) < 1e-4, (obs["0"], obs["1"])
+    # Re and |div| also agree; the hybrid must not degrade divergence control
+    assert abs(obs["1"][2] - obs["0"][2]) / abs(obs["0"][2]) < 1e-4
+    assert obs["1"][3] < 2 * max(obs["0"][3], 1e-12)
