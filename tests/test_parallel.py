@@ -288,3 +288,32 @@ def test_sharded_sep_layout_matches_serial(monkeypatch):
             atol=1e-12,
             err_msg=attr,
         )
+
+
+def test_sharded_split_periodic_mixed_sep_matches_serial(monkeypatch):
+    """The REAL multi-chip periodic path: split Re/Im Fourier x Chebyshev
+    with the Chebyshev axis in the sep layout (the at-scale periodic1024
+    candidate, VERDICT r4 next #2) — sharded == serial."""
+    monkeypatch.setenv("RUSTPDE_FORCE_TPU_PATH", "1")
+    monkeypatch.setenv("RUSTPDE_SEP", "1")
+
+    def build(mesh):
+        model = Navier2D(16, 17, 1e4, 1.0, 5e-3, 1.0, "rbc", periodic=True, mesh=mesh)
+        assert model.temp_space.bases[0].kind.is_split
+        assert model.temp_space.sep == (False, True)
+        model.set_velocity(0.1, 1.0, 1.0)
+        model.set_temperature(0.1, 1.0, 1.0)
+        return model
+
+    serial = build(None)
+    sharded = build(make_mesh())
+    serial.update_n(8)
+    sharded.update_n(8)
+    for attr in ("temp", "velx", "vely", "pres", "pseu"):
+        np.testing.assert_allclose(
+            np.asarray(getattr(sharded.state, attr)),
+            np.asarray(getattr(serial.state, attr)),
+            atol=1e-12,
+            err_msg=attr,
+        )
+    assert sharded.eval_nu() == pytest.approx(serial.eval_nu(), abs=1e-12)
