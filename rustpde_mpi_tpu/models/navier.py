@@ -409,6 +409,30 @@ class Navier2D(Integrate):
         solid = self._solid
         proj_grad = self._proj_grad
 
+        # RUSTPDE_SOLVE_PRECISION: experiment knob (default OFF) scoping a
+        # matmul-precision override to the four implicit solves ONLY — the
+        # remaining 6-pass GEMM family after the fast-synthesis work.  A
+        # trace-time jax.default_matmul_precision context covers every GEMM
+        # inside the solves (precond matvecs, dense inverses, modal maps)
+        # without touching the shared impl classes.  f64 never downgrades.
+        # Gates if ever defaulted: div-norm decay, Poisson MMS, shadow,
+        # FAST_SYNTH-style long-horizon stats (the r2 NaN came from a GLOBAL
+        # "high"; this is the scoped form).
+        import os
+
+        solve_prec = (
+            os.environ.get("RUSTPDE_SOLVE_PRECISION") or None
+            if not config.X64
+            else None
+        )
+
+        def solve_scope():
+            if solve_prec:
+                return jax.default_matmul_precision(solve_prec)
+            import contextlib
+
+            return contextlib.nullcontext()
+
         def conv(ux, uy, space, vhat, with_bc=False):
             """u . grad(v), dealiased, in scratch-ortho space
             (/root/reference/src/navier_stokes/functions.rs:56-69 +
@@ -447,20 +471,23 @@ class Navier2D(Integrate):
             rhs = sp_u.to_ortho(velx)
             rhs = rhs - dt * sp_p.gradient(pres, (1, 0), scale)
             rhs = rhs - dt * conv(ux, uy, sp_u, velx)
-            velx_n = sol_u.solve(rhs)
+            with solve_scope():
+                velx_n = sol_u.solve(rhs)
 
             # vertical momentum + buoyancy (navier_eq.rs:190-203)
             rhs = sp_v.to_ortho(vely)
             rhs = rhs - dt * sp_p.gradient(pres, (0, 1), scale)
             rhs = rhs + dt * that
             rhs = rhs - dt * conv(ux, uy, sp_v, vely)
-            vely_n = sol_v.solve(rhs)
+            with solve_scope():
+                vely_n = sol_v.solve(rhs)
 
             # pressure projection (navier_eq.rs:19-25,117-125,137-143,158-162)
             div = sp_u.gradient(velx_n, (1, 0), scale) + sp_v.gradient(
                 vely_n, (0, 1), scale
             )
-            pseu_n = sol_p.solve(div)
+            with solve_scope():
+                pseu_n = sol_p.solve(div)
             pseu_n = sp_q.pin_zero_mode(pseu_n)  # remove singularity
             if proj_grad is not None:
                 gx0, gx1, gy0, gy1 = proj_grad
@@ -476,7 +503,8 @@ class Navier2D(Integrate):
             rhs = sp_t.to_ortho(temp)
             rhs = rhs + tb_diff
             rhs = rhs - dt * conv(ux, uy, sp_t, temp, with_bc=True)
-            temp_n = sol_t.solve(rhs)
+            with solve_scope():
+                temp_n = sol_t.solve(rhs)
 
             if solid is not None:
                 # implicit pointwise Brinkman penalization (set_solid):
