@@ -1,0 +1,62 @@
+#!/bin/bash
+# Round-5 measurement runbook: run this when the axon relay comes back.
+# Executes every queued on-chip measurement in dependency order and leaves
+# the results in ./runbook_out/. Decisions (default flips) stay manual —
+# read the A/B outputs against the gates in BASELINE.md "Round-5 changes".
+#
+# Usage: bash scripts/relay_runbook.sh [--quick]
+#   --quick: skip the long legs (fast-synthesis validation, f64 profile)
+set -u
+cd "$(dirname "$0")/.."
+OUT=runbook_out
+mkdir -p "$OUT"
+QUICK="${1:-}"
+
+run() { # run <name> <timeout_s> <cmd...>
+    local name=$1 to=$2; shift 2
+    echo "=== $name ($(date +%H:%M:%S)) ==="
+    timeout "$to" "$@" >"$OUT/$name.log" 2>&1
+    echo "rc=$? -> $OUT/$name.log"
+    tail -3 "$OUT/$name.log" | sed 's/^/    /'
+}
+
+# 0. probe
+run probe 120 python -c "import jax; print(jax.devices())" || true
+grep -q "axon\|Tpu" "$OUT/probe.log" || { echo "relay still down; aborting"; exit 1; }
+
+# 1. full matrix at HEAD (warms the compile cache for everything below;
+#    generous budget so no config rotates stale on this first post-outage run)
+RUSTPDE_BENCH_BUDGET_S=1800 RUSTPDE_BENCH_SLACK_S=900 \
+    run bench_full_1 2900 python bench.py
+
+# 2. step-level A/Bs at the flagships (defaults off -> baseline numbers are
+#    in bench_full_1; these runs measure the knobs ON)
+ab() { # ab <name> <env=val> <call>
+    local name=$1 env=$2 call=$3
+    run "$name" 900 env $env python -c "import bench, json; print(json.dumps($call))"
+}
+ab ab_fwdprec_1025 "RUSTPDE_FWD_PRECISION=high" "bench.bench_navier(1025,1025,1e9,1e-4,64)"
+ab ab_fwdprec_2049 "RUSTPDE_FWD_PRECISION=high" "bench.bench_navier(2049,2049,1e9,5e-5,16)"
+ab ab_solveprec_1025 "RUSTPDE_SOLVE_PRECISION=high" "bench.bench_navier(1025,1025,1e9,1e-4,64)"
+ab ab_solveprec_2049 "RUSTPDE_SOLVE_PRECISION=high" "bench.bench_navier(2049,2049,1e9,5e-5,16)"
+ab ab_both_1025 "RUSTPDE_FWD_PRECISION=high RUSTPDE_SOLVE_PRECISION=high" "bench.bench_navier(1025,1025,1e9,1e-4,64)"
+# periodic1024: sep layout on the Chebyshev axis vs default
+ab ab_periodic_sep "RUSTPDE_SEP=1" "bench.bench_navier(1024,1025,1e9,1e-4,16,periodic=True)"
+# periodic1024: fourstep vs circ-fold on the 1024 Fourier axis
+ab ab_periodic_nofourstep "RUSTPDE_FOURSTEP=0" "bench.bench_navier(1024,1025,1e9,1e-4,16,periodic=True)"
+
+# 3. f64 hybrid perf leg (writes F64_HYBRID_AB.json)
+run hybrid_perf 3600 python scripts/ab_f64_hybrid.py --perf
+
+if [ "$QUICK" != "--quick" ]; then
+    # 4. long-horizon fast-synthesis statistics artifact
+    run fast_synth 3600 python scripts/validate_fast_synthesis.py
+    # 5. f64 component profile at the flagship (VERDICT r4 next #3a)
+    run profile_f64_2049 3600 env RUSTPDE_X64=1 python scripts/profile_step.py --n 2049 --iters 2
+    # 6. shadow-gated full matrix again at defaults: the recorded state the
+    #    driver capture will reproduce (all-fresh, zero stale)
+    RUSTPDE_BENCH_BUDGET_S=900 RUSTPDE_BENCH_SLACK_S=600 \
+        run bench_full_2 1600 python bench.py
+fi
+
+echo "=== runbook done ($(date +%H:%M:%S)); results in $OUT/ ==="
