@@ -194,3 +194,57 @@ def test_f64_hybrid_tracks_full_f64():
     # Re and |div| also agree; the hybrid must not degrade divergence control
     assert abs(obs["1"][2] - obs["0"][2]) / abs(obs["0"][2]) < 1e-4
     assert obs["1"][3] < 2 * max(obs["0"][3], 1e-12)
+
+
+def test_f64_hybrid_sharded_matches_serial():
+    """The f64 hybrid under the 8-device pencil mesh == serial hybrid: the
+    f32-cast convection operators must partition cleanly under GSPMD (real
+    multichip would run exactly this combination)."""
+    import os
+    import re
+    import subprocess
+    import sys
+
+    code = (
+        "import jax\n"
+        "jax.config.update('jax_platforms', 'cpu')\n"
+        "import numpy as np\n"
+        "from jax.sharding import Mesh\n"
+        "from rustpde_mpi_tpu import Navier2D\n"
+        "from rustpde_mpi_tpu.parallel.mesh import AXIS\n"
+        "def build(mesh):\n"
+        "    m = Navier2D(17, 16, 1e4, 1.0, 1e-2, 1.0, 'rbc', periodic=False, mesh=mesh)\n"
+        "    m.set_velocity(0.1, 1.0, 1.0)\n"
+        "    m.set_temperature(0.1, 1.0, 1.0)\n"
+        "    return m\n"
+        "serial = build(None)\n"
+        "mesh = Mesh(np.array(jax.devices()[:8]), (AXIS,))\n"
+        "sharded = build(mesh)\n"
+        "serial.update_n(6)\n"
+        "sharded.update_n(6)\n"
+        "# f32 GEMM segments reassociate differently under partitioning; the\n"
+        "# agreement bar is f32 roundoff (observed ~2e-11), not bitwise\n"
+        "np.testing.assert_allclose(np.asarray(sharded.state.temp),\n"
+        "                           np.asarray(serial.state.temp), atol=1e-9)\n"
+        "print('OK', serial.eval_nu())\n"
+    )
+    env = dict(
+        os.environ,
+        RUSTPDE_X64="1",
+        RUSTPDE_FORCE_TPU_PATH="1",
+        RUSTPDE_F64_HYBRID="1",
+        JAX_PLATFORMS="cpu",
+    )
+    env["XLA_FLAGS"] = (
+        re.sub(
+            r"--xla_force_host_platform_device_count=\d+", "",
+            env.get("XLA_FLAGS", ""),
+        ).strip()
+        + " --xla_force_host_platform_device_count=8"
+    ).strip()
+    out = subprocess.run(
+        [sys.executable, "-c", code],
+        capture_output=True, text=True, env=env, timeout=900,
+    )
+    assert out.returncode == 0, out.stderr[-3000:]
+    assert "OK" in out.stdout
