@@ -74,3 +74,66 @@ def read_pencil(filename, dsname: str, decomp, rank: int, pencil: str = "y",
     """One rank's slab of a dataset."""
     p = (decomp.y_pencil if pencil == "y" else decomp.x_pencil)(rank)
     return read_slice(filename, dsname, p.st, p.sz, is_complex=is_complex)
+
+
+def write_pencils_concurrent(
+    filename, dsname: str, arr, decomp, pencil: str = "y", max_workers=None
+) -> None:
+    """TRUE-parallel pencil writer — the TPU-native analog of the reference's
+    concurrent MPIO path, which it ships disabled
+    (/root/reference/src/field_mpi/io_mpi.rs:14-108 behind the off-by-default
+    ``mpio`` feature; SURVEY S2 rows field_mpi::io_mpi /
+    io::future_read_write_mpi_hdf5).
+
+    Parallel HDF5 needs an MPI-enabled libhdf5; instead each rank-slab is
+    written CONCURRENTLY to its own shard file (``{filename}.{dsname}.shardN``
+    — independent files, no library lock to serialize on; h5py releases the
+    GIL during chunk IO, and in a real multi-host deployment each host writes
+    its own shard natively) and the main file exposes the global dataset as
+    an HDF5 *virtual dataset* over the shards — readers (``read_slice`` /
+    ``read_pencil`` / h5py) see the same global dataset as the sequential
+    writer produces, with zero stitching copies.  The shard files must travel
+    with the main file (HDF5 resolves them relative to it)."""
+    import os
+    from concurrent.futures import ThreadPoolExecutor
+
+    # complex data splits into _re/_im virtual datasets like write_slice
+    probe = np.asarray(arr[tuple(slice(0, 1) for _ in decomp.global_shape)])
+    if np.iscomplexobj(probe):
+        write_pencils_concurrent(
+            filename, dsname + "_re", np.real(arr), decomp, pencil, max_workers
+        )
+        write_pencils_concurrent(
+            filename, dsname + "_im", np.imag(arr), decomp, pencil, max_workers
+        )
+        return
+    h5py = _h5()
+    get = decomp.y_pencil if pencil == "y" else decomp.x_pencil
+    global_shape = tuple(decomp.global_shape)
+    pencils = [get(rank) for rank in range(decomp.nprocs)]
+    base = os.path.basename(filename)
+
+    def write_shard(rank_p):
+        rank, p = rank_p
+        sel = tuple(slice(st, st + s) for st, s in zip(p.st, p.sz))
+        block = np.ascontiguousarray(np.asarray(arr[sel]))
+        shard = f"{filename}.{dsname.replace('/', '_')}.shard{rank}"
+        with h5py.File(shard, "w") as f:
+            f.create_dataset("slab", data=block)
+        return rank, block.dtype
+
+    with ThreadPoolExecutor(max_workers=max_workers or min(8, len(pencils))) as ex:
+        dtypes = dict(ex.map(write_shard, enumerate(pencils)))
+    layout = h5py.VirtualLayout(shape=global_shape, dtype=dtypes[0])
+    for rank, p in enumerate(pencils):
+        sel = tuple(slice(st, st + s) for st, s in zip(p.st, p.sz))
+        vs = h5py.VirtualSource(
+            f"./{base}.{dsname.replace('/', '_')}.shard{rank}",
+            "slab",
+            shape=tuple(p.sz),
+        )
+        layout[sel] = vs
+    with h5py.File(filename, "a") as f:
+        if dsname in f:
+            del f[dsname]
+        f.create_virtual_dataset(dsname, layout)
