@@ -25,14 +25,15 @@ def main():
     ap.add_argument("--periodic", action="store_true")
     args = ap.parse_args()
 
-    # trace on CPU regardless of the session backend: make_jaxpr executes
-    # nothing, and the CPU backend cannot hang on a dead relay
+    # trace on CPU unconditionally: make_jaxpr itself executes nothing, but
+    # the operator-constant placement (bases._dev ensure_compile_time_eval)
+    # DOES run device transfers — on the axon backend that hangs when the
+    # relay is down, and this script never needs the chip
     os.environ.setdefault("RUSTPDE_FORCE_TPU_PATH", "1")
-    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    os.environ["JAX_PLATFORMS"] = "cpu"
     import jax
 
-    if os.environ.get("JAX_PLATFORMS") == "cpu":
-        jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_platforms", "cpu")
 
     from rustpde_mpi_tpu import Navier2D, config
     from rustpde_mpi_tpu.utils.profiling import _jaxpr_dot_flops
@@ -95,24 +96,19 @@ def main():
     except ValueError:
         fd = flops(lambda a: sp_f.forward(a), ex["phys"])
     rec("conv forwards: 3x forward_dealiased", fd, 3)
-    # implicit solves
-    so = 0.0
-    for sol, sp in (
-        (model.solver_velx, sp_u),
-        (model.solver_vely, sp_v),
-        (model.solver_temp, sp_t),
-    ):
-        e = jax.ShapeDtypeStruct(
-            sp.shape_spectral,
-            config.real_dtype() if not sp.spectral_is_complex else sp.spectral_dtype(),
-        )
-        so += flops(sol.solve, e)
-    rec("3x ADI Helmholtz solve", so)
-    e = jax.ShapeDtypeStruct(
-        sp_q.shape_spectral,
-        config.real_dtype() if not sp_q.spectral_is_complex else sp_q.spectral_dtype(),
+    # implicit solves: rhs lives in the ORTHO (field) space, like the step's
+    # to_ortho/conv outputs
+    ortho_ex = jax.ShapeDtypeStruct(
+        sp_f.shape_spectral,
+        config.real_dtype() if not sp_f.spectral_is_complex
+        else sp_f.spectral_dtype(),
     )
-    rec("Poisson solve (pseudo-pressure)", flops(model.solver_pres.solve, e))
+    so = sum(
+        flops(sol.solve, ortho_ex)
+        for sol in (model.solver_velx, model.solver_vely, model.solver_temp)
+    )
+    rec("3x ADI Helmholtz solve", so)
+    rec("Poisson solve (pseudo-pressure)", flops(model.solver_pres.solve, ortho_ex))
     # gradients / projection
     g = flops(lambda a: sp_p.gradient(a, (1, 0), scale), ex["p"]) + flops(
         lambda a: sp_p.gradient(a, (0, 1), scale), ex["p"]
