@@ -26,6 +26,7 @@ from .bases import (  # noqa: F401
 )
 from .bases import BiPeriodicSpace2, Space1  # noqa: F401
 from .field import Field1, Field2, average, average_axis, norm_l2  # noqa: F401
+from .models.ensemble import NavierEnsemble  # noqa: F401
 from .models.lnse import Navier2DLnse, Navier2DNonLin  # noqa: F401
 from .models.meanfield import MeanFields  # noqa: F401
 from .models.navier import Navier2D, NavierState  # noqa: F401

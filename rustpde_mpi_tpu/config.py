@@ -140,6 +140,9 @@ class NavierConfig:
     write_intervall: float | None = None
     init_random_amp: float | None = 0.1
     params: dict = field(default_factory=dict)  # extra params recorded to h5
+    # member count for NavierEnsemble.from_config (1 = plain single run);
+    # members share the operator constants and differ by IC seed
+    ensemble: int = 1
 
     def ctor_args(self) -> tuple:
         return (self.nx, self.ny, self.ra, self.pr, self.dt, self.aspect, self.bc)

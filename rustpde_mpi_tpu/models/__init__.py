@@ -1,5 +1,6 @@
 """Physics model layer: Navier-Stokes DNS and derived solvers."""
 
+from .ensemble import NavierEnsemble  # noqa: F401
 from .lnse import Navier2DLnse, Navier2DNonLin  # noqa: F401
 from .meanfield import MeanFields  # noqa: F401
 from .navier import Navier2D, NavierState  # noqa: F401

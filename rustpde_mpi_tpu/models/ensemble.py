@@ -1,0 +1,337 @@
+"""Batched ensemble execution engine: K independent RBC simulations per dispatch.
+
+The inference-stack analogue of request batching, applied to DNS: at the
+small/medium grids that dominate parameter sweeps and optimal-perturbation
+campaigns a single 129² step fills ~4% of the chip (BENCH_FULL.json
+``rbc129.mfu``), so K independent members are stacked on a leading axis and
+advanced by ONE vmapped, jitted, chunked ``lax.scan`` dispatch.  Design
+points:
+
+* **one physics code path** — the member step is :class:`Navier2D`'s own
+  hoisted jaxpr (``model._step_cc``) under ``jax.vmap``; the ensemble forks
+  no physics, it only adds the batch axis.  Members therefore share the
+  model's operator constants (grid, Ra, Pr, dt — the implicit solvers bake
+  ``dt*nu`` into their factorizations), so a parameter *scan* maps to one
+  ensemble per parameter value with K seed-decorrelated members inside
+  (``examples/navier_rbc_ensemble.py``).
+* **buffer donation** — the chunked step donates states + mask + counters
+  (``donate_argnums``): XLA aliases the input coefficient buffers to the
+  outputs, so the resident HBM footprint is ONE stacked state, not a double
+  buffer per dispatch.  :meth:`update_n` dispatches a fresh copy first so
+  references retained to ``.state`` / ``.mask`` stay valid.
+* **per-member fault isolation** — the single-run in-chunk NaN early-exit
+  (a scalar is-finite carry flag, models/navier.py) generalizes to a
+  per-member finite **mask**: a diverging member freezes at its last finite
+  state (``jnp.where`` select — inside a vmapped batch a ``lax.cond`` lowers
+  to a select anyway, so the frozen member costs its lanes but cannot
+  corrupt or kill the batch), ``steps_done`` records how far each member
+  got, and the whole-batch scalar early-exit still fires once EVERY member
+  is dead.  Graceful degradation, reported per member.
+* **batched observables / IO** — the fused ``(Nu, Nuvol, Re, |div|)``
+  diagnostics vmap to shape ``(K,)``; snapshots write per-member groups
+  (utils/checkpoint.write_ensemble_snapshot); ``benchmark_steps`` reports
+  aggregate member-steps/s and ensemble MFU.
+
+Composes with the pencil-sharding mesh: the member axis is a leading batch
+dim, which the transform layer replicates across shards (bases.Space2), so
+members are batched *within* each pencil shard.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..utils.integrate import Integrate
+from .navier import Navier2D, NavierState
+
+
+class NavierEnsemble(Integrate):
+    """K member states of one :class:`Navier2D`, stepped as one dispatch.
+
+    ``states`` is either a sequence of K :class:`NavierState` pytrees or an
+    already-stacked state (every leaf carrying a leading K axis).  Members
+    share ``model``'s spaces, solvers and parameters; only the state differs.
+    """
+
+    def __init__(self, model: Navier2D, states):
+        if isinstance(states, NavierState):
+            if np.ndim(states.temp) != np.ndim(model.state.temp) + 1:
+                raise TypeError(
+                    "NavierEnsemble expects a sequence of member states or a "
+                    "NavierState whose leaves carry a leading K axis; got an "
+                    "unbatched NavierState — wrap it in a list for K=1"
+                )
+            stacked = states
+        else:
+            members = list(states)
+            if not members:
+                raise ValueError("ensemble needs at least one member state")
+            with model._scope():
+                stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *members)
+        self.model = model
+        self.k = int(stacked.temp.shape[0])
+        self.dt = model.dt
+        self.time = 0.0
+        self.write_intervall = model.write_intervall
+        # per-member diagnostics history: each append is a length-K list
+        self.diagnostics: dict[str, list] = {}
+        self._obs_cache: tuple | None = None
+        self._compile_entry_points()
+        with model._scope():
+            self.state = stacked
+            self.mask = self._finite_mask(stacked)
+            self.steps_done = jnp.zeros((self.k,), jnp.int32)
+
+    # -- construction --------------------------------------------------------
+
+    @classmethod
+    def from_seeds(cls, model: Navier2D, seeds, amp: float = 0.1) -> "NavierEnsemble":
+        """K members from the model's random-IC generator, one seed each —
+        the DNS-statistics / parameter-scan workload (decorrelated initial
+        conditions under shared operators).  The model's own state is
+        restored afterwards."""
+        keep = model.state
+        members = []
+        try:
+            for seed in seeds:
+                model.init_random(amp, seed=int(seed))
+                members.append(model.state)
+        finally:
+            model.state = keep
+        return cls(model, members)
+
+    @classmethod
+    def replicate(cls, model: Navier2D, k: int) -> "NavierEnsemble":
+        """K copies of the model's current state (perturbation campaigns
+        differentiate members afterwards via :meth:`set_member`)."""
+        return cls(model, [model.state] * int(k))
+
+    @classmethod
+    def from_config(cls, cfg, mesh=None) -> "NavierEnsemble":
+        """Build the template model from a
+        :class:`~rustpde_mpi_tpu.config.NavierConfig` and seed
+        ``cfg.ensemble`` members (seeds 0..K-1).  An unset/zero
+        ``init_random_amp`` means what it means on the single-run path — no
+        random IC — so the members replicate the model's current state
+        (differentiate them afterwards via :meth:`set_member`)."""
+        model = Navier2D.from_config(cfg, mesh=mesh)
+        k = max(1, cfg.ensemble)
+        if not cfg.init_random_amp:
+            return cls.replicate(model, k)
+        return cls.from_seeds(model, range(k), amp=cfg.init_random_amp)
+
+    # -- member access -------------------------------------------------------
+
+    @property
+    def ensemble_size(self) -> int:
+        """Member count (read by utils/profiling.benchmark_steps)."""
+        return self.k
+
+    @property
+    def nx(self) -> int:
+        return self.model.nx
+
+    @property
+    def ny(self) -> int:
+        return self.model.ny
+
+    def member_state(self, i: int) -> NavierState:
+        """Member ``i``'s state as an unbatched :class:`NavierState`."""
+        return jax.tree.map(lambda x: x[i], self.state)
+
+    def set_member(self, i: int, state: NavierState) -> None:
+        """Replace member ``i``'s state (and re-derive its mask/counter)."""
+        with self.model._scope():
+            self.state = jax.tree.map(
+                lambda st, leaf: st.at[i].set(leaf), self.state, state
+            )
+            self.mask = self.mask.at[i].set(jnp.isfinite(jnp.sum(state.temp)))
+            self.steps_done = self.steps_done.at[i].set(0)
+        self._obs_cache = None
+
+    def get_field(self, name: str, member: int) -> np.ndarray:
+        """Physical values of one member's variable."""
+        space = getattr(self.model, f"{name}_space")
+        with self.model._scope():
+            return np.asarray(space.backward(getattr(self.member_state(member), name)))
+
+    # -- the batched step ----------------------------------------------------
+
+    def _finite_mask(self, stacked: NavierState):
+        """Per-member is-finite over temp — the same one-reduction detector
+        the single-run early-exit uses (a NaN anywhere infects temp within
+        one step via buoyancy/convection, models/navier.py)."""
+        return jnp.isfinite(
+            jnp.sum(stacked.temp, axis=tuple(range(1, stacked.temp.ndim)))
+        )
+
+    def _compile_entry_points(self) -> None:
+        model = self.model
+        step_cc = model._step_cc
+        obs_cc = model._obs_cc
+
+        def ens_step_n(consts, states, mask, done, n: int):
+            """n vmapped steps with per-member fault isolation: the carry
+            holds (states, alive-mask, per-member step counters).  An alive
+            member whose stepped temp goes non-finite is frozen at its last
+            finite state via a per-member select; once NO member is alive the
+            remaining iterations take the identity branch of the scalar
+            ``lax.cond`` (the single-run early-exit, batch-wide)."""
+
+            vstep = jax.vmap(lambda s: step_cc(consts, s))
+
+            def advance(carry):
+                st, ok, dn = carry
+                st2 = vstep(st)
+                ok2 = ok & self._finite_mask(st2)
+
+                def freeze(new, old):
+                    sel = jnp.reshape(ok2, ok2.shape + (1,) * (new.ndim - 1))
+                    return jnp.where(sel, new, old)
+
+                return jax.tree.map(freeze, st2, st), ok2, dn + ok2.astype(jnp.int32)
+
+            def body(carry, _):
+                carry2 = jax.lax.cond(jnp.any(carry[1]), advance, lambda c: c, carry)
+                return carry2, None
+
+            (st, mk, dn), _ = jax.lax.scan(body, (states, mask, done), None, length=n)
+            return st, mk, dn
+
+        # donation: states + mask + counters alias input->output buffers, so
+        # the resident footprint is one stacked state (see module docstring);
+        # the consts (operator matrices) are shared and NEVER donated
+        ens_jit = jax.jit(
+            ens_step_n, static_argnames=("n",), donate_argnums=(1, 2, 3)
+        )
+        self._step_n = lambda st, mk, dn, n: ens_jit(
+            model._step_consts, st, mk, dn, n=n
+        )
+
+        # fused (Nu, Nuvol, Re, |div|) vmapped to shape (K,)
+        obs_jit = jax.jit(jax.vmap(obs_cc, in_axes=(None, 0)))
+        self._obs_fn = lambda st: obs_jit(model._obs_consts, st)
+
+    def _make_step(self):
+        """vmapped single-member step — profiling.step_flops introspects this
+        (the batched dot_generals in its jaxpr carry the K factor, so the
+        reported ensemble MFU is per dispatch, all members included)."""
+        return jax.vmap(self.model._make_step())
+
+    # -- Integrate protocol --------------------------------------------------
+
+    def update(self) -> None:
+        self.update_n(1)
+
+    def update_n(self, n: int) -> None:
+        """Advance every alive member n steps in scanned power-of-two chunks.
+
+        The chunked dispatch donates its carry, so it must never receive the
+        user-visible buffers — one copy of (state, mask, counters) per call
+        keeps retained references valid while every inter-bucket hand-off
+        inside the chain is donated.  ``self.time`` counts scheduled steps;
+        ``self.steps_done`` records how far each member actually advanced."""
+        from ..utils.jit import run_scanned
+
+        with self.model._scope():
+            carry = jax.tree.map(
+                jnp.copy, (self.state, self.mask, self.steps_done)
+            )
+            carry = run_scanned(
+                lambda c, k: self._step_n(c[0], c[1], c[2], k), carry, n
+            )
+            self.state, self.mask, self.steps_done = carry
+        self.time += n * self.dt
+        self._obs_cache = None
+
+    def get_time(self) -> float:
+        return self.time
+
+    def get_dt(self) -> float:
+        return self.dt
+
+    def reset_time(self) -> None:
+        self.time = 0.0
+
+    def alive(self) -> np.ndarray:
+        """Per-member alive mask as a host bool array of shape (K,)."""
+        return np.asarray(self.mask)
+
+    def exit(self) -> bool:
+        """Graceful degradation: the break criterion fires only when EVERY
+        member has diverged — one NaN member freezes (update_n) and is
+        reported per member, it does not kill the batch."""
+        return not bool(np.any(self.alive()))
+
+    # -- observables / IO ----------------------------------------------------
+
+    def get_observables(self) -> tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+        """(Nu, Nuvol, Re, |div|), each a float ndarray of shape (K,) — one
+        fused vmapped dispatch, cached per state.  NOTE a member that
+        diverged mid-run is frozen at its last FINITE state, so its entries
+        are finite but STALE; only a member whose IC was already non-finite
+        reports NaN.  Liveness is :meth:`alive` / ``mask``, not
+        ``isfinite(nu)``."""
+        if self._obs_cache is None or self._obs_cache[0] is not self.state:
+            with self.model._scope():
+                values = tuple(np.asarray(v) for v in self._obs_fn(self.state))
+            self._obs_cache = (self.state, values)
+        return self._obs_cache[1]
+
+    def eval_nu(self) -> np.ndarray:
+        return self.get_observables()[0]
+
+    def eval_nuvol(self) -> np.ndarray:
+        return self.get_observables()[1]
+
+    def eval_re(self) -> np.ndarray:
+        return self.get_observables()[2]
+
+    def div_norm(self) -> np.ndarray:
+        return self.get_observables()[3]
+
+    def callback(self) -> None:
+        """Per-interval reporting: append per-member diagnostics, print an
+        aggregate line, write the ensemble snapshot when ``write_intervall``
+        says so (the single-run callback's throttling rule)."""
+        nu, nuvol, re, div = self.get_observables()
+        alive = self.alive()
+        t = self.time
+        for key, val in (
+            ("time", [t] * self.k),
+            ("nu", nu),
+            ("nuvol", nuvol),
+            ("re", re),
+            ("div", div),
+            ("alive", alive.astype(float)),
+        ):
+            self.diagnostics.setdefault(key, []).append(list(map(float, val)))
+        n_alive = int(alive.sum())
+        if n_alive:
+            live = nu[alive]
+            nu_info = f"Nu = {live.mean():5.3e} [{live.min():5.3e}, {live.max():5.3e}]"
+        else:
+            nu_info = "Nu = --- (all members diverged)"
+        print(f"time = {t:9.3f}      alive = {n_alive}/{self.k}      {nu_info}")
+        # single-run rule (utils/navier_io.callback): write every save
+        # interval unless write_intervall throttles it further
+        wi = self.write_intervall
+        if wi is None or (t + self.dt / 2.0) % wi < self.dt:
+            try:
+                self.write(f"data/ensemble{t:08.2f}.h5")
+            except OSError as exc:  # never fatal, like the single-run callback
+                print(f"unable to write ensemble snapshot: {exc}")
+
+    def write(self, filename: str) -> None:
+        """Write a K-member snapshot (per-member groups, utils/checkpoint)."""
+        from ..utils import checkpoint
+
+        checkpoint.write_ensemble_snapshot(self, filename)
+
+    def read(self, filename: str) -> None:
+        """Restore members (+ mask, counters, time) from an ensemble snapshot."""
+        from ..utils import checkpoint
+
+        checkpoint.read_ensemble_snapshot(self, filename)

@@ -191,6 +191,11 @@ class Navier2D(Integrate):
             obs_cc, obs_consts = hoist_constants(self._make_observables(), example)
         self._step_consts = step_consts
         self._obs_consts = obs_consts
+        # retained for the ensemble engine (models/ensemble.py): the SAME
+        # traced jaxpr is vmapped over a leading member axis there — one
+        # physics code path, batch as a leading axis, no forked step
+        self._step_cc = step_cc
+        self._obs_cc = obs_cc
         step_jit = jax.jit(step_cc)
         self._step = lambda s: step_jit(self._step_consts, s)
 
@@ -219,7 +224,15 @@ class Navier2D(Integrate):
             (final, _, done), _ = jax.lax.scan(body, init, None, length=n)
             return final, done
 
-        step_n_jit = jax.jit(step_n, static_argnames=("n",))
+        # donate the state: XLA aliases the five input coefficient buffers to
+        # the scan carry's outputs, so a chunked dispatch updates the state
+        # in place instead of holding a second resident copy in HBM.  Callers
+        # must hand in buffers they no longer need — update_n dispatches a
+        # fresh copy first, keeping references retained to ``self.state``
+        # across the call valid (no use-after-donate on the public API).
+        step_n_jit = jax.jit(
+            step_n, static_argnames=("n",), donate_argnums=(1,)
+        )
         self._step_n = lambda s, n: step_n_jit(self._step_consts, s, n=n)
         obs_jit = jax.jit(obs_cc)
         self._obs_fn = lambda s: obs_jit(self._obs_consts, s)
@@ -459,6 +472,19 @@ class Navier2D(Integrate):
             return sp_f.forward(total) * mask
 
         def step(state: NavierState) -> NavierState:
+            # pin the implicit-solve inputs to the spectral x-pencil layout
+            # (no-op without a mesh, and on non-divisible extents — current
+            # JAX rounds those constraints to replicated): asserts the pencil
+            # discipline at the solve boundaries so GSPMD propagation cannot
+            # drift the solve internals onto other layouts on real
+            # (divisible) meshes.  NOTE it does NOT cure the fused split-sep
+            # miscompile tracked in test_parallel.py::
+            # test_sharded_split_periodic_mixed_sep_matches_serial (xfail).
+            from ..parallel.mesh import SPEC, constrain
+
+            def pin(a):
+                return constrain(a, SPEC)
+
             temp, velx, vely, pres, pseu = state
             # buoyancy (full ortho space, includes the lift field)
             that = sp_t.to_ortho(temp) + tb_ortho
@@ -472,7 +498,7 @@ class Navier2D(Integrate):
             rhs = rhs - dt * sp_p.gradient(pres, (1, 0), scale)
             rhs = rhs - dt * conv(ux, uy, sp_u, velx)
             with solve_scope():
-                velx_n = sol_u.solve(rhs)
+                velx_n = sol_u.solve(pin(rhs))
 
             # vertical momentum + buoyancy (navier_eq.rs:190-203)
             rhs = sp_v.to_ortho(vely)
@@ -480,14 +506,14 @@ class Navier2D(Integrate):
             rhs = rhs + dt * that
             rhs = rhs - dt * conv(ux, uy, sp_v, vely)
             with solve_scope():
-                vely_n = sol_v.solve(rhs)
+                vely_n = sol_v.solve(pin(rhs))
 
             # pressure projection (navier_eq.rs:19-25,117-125,137-143,158-162)
             div = sp_u.gradient(velx_n, (1, 0), scale) + sp_v.gradient(
                 vely_n, (0, 1), scale
             )
             with solve_scope():
-                pseu_n = sol_p.solve(div)
+                pseu_n = sol_p.solve(pin(div))
             pseu_n = sp_q.pin_zero_mode(pseu_n)  # remove singularity
             if proj_grad is not None:
                 gx0, gx1, gy0, gy1 = proj_grad
@@ -504,7 +530,7 @@ class Navier2D(Integrate):
             rhs = rhs + tb_diff
             rhs = rhs - dt * conv(ux, uy, sp_t, temp, with_bc=True)
             with solve_scope():
-                temp_n = sol_t.solve(rhs)
+                temp_n = sol_t.solve(pin(rhs))
 
             if solid is not None:
                 # implicit pointwise Brinkman penalization (set_solid):
@@ -514,7 +540,13 @@ class Navier2D(Integrate):
                 vely_n = sp_v.forward(sp_v.backward(vely_n) * fac)
                 temp_n = sp_t.forward(sp_t.backward(temp_n) * fac + temp_add)
 
-            return NavierState(temp_n, velx_n, vely_n, pres_n, pseu_n)
+            # pin the step outputs too: the next step's transforms assume the
+            # x-pencil layout, and XLA's sharding propagation is free to emit
+            # replicated outputs otherwise — which silently serializes a
+            # multi-chip run
+            return NavierState(
+                pin(temp_n), pin(velx_n), pin(vely_n), pin(pres_n), pin(pseu_n)
+            )
 
         return step
 
@@ -584,15 +616,20 @@ class Navier2D(Integrate):
     def update_n(self, n: int) -> None:
         """Advance n steps on the device via scanned power-of-two chunks
         (utils/jit.run_scanned).  Dispatches stay asynchronous (no per-bucket
-        host sync — through the relay a sync costs ~110 ms); on divergence
-        the in-scan early exit freezes the state, ``exit()`` reports it at
-        the next chunk boundary, and ``self.time`` deliberately counts the
+        host sync — through the relay a sync costs ~110 ms) and donate their
+        input state buffers (see _compile_entry_points); on divergence the
+        in-scan early exit freezes the state, ``exit()`` reports it at the
+        next chunk boundary, and ``self.time`` deliberately counts the
         scheduled steps (the post-NaN run is over either way)."""
         from ..utils.jit import run_scanned
 
         with self._scope():
+            # the chunked dispatch donates its input buffers; hand it a copy
+            # so a state reference the caller retained stays readable, while
+            # every inter-bucket hand-off inside the chain is donated
+            state = jax.tree.map(jnp.copy, self.state)
             self.state = run_scanned(
-                lambda s, k: self._step_n(s, k)[0], self.state, n
+                lambda s, k: self._step_n(s, k)[0], state, n
             )
         self.time += n * self.dt
 

@@ -95,6 +95,11 @@ def _banded_solve_moved(lower, upper, p: int, q: int, b):
     low = jnp.broadcast_to(lower, batch_shape + lower.shape[-2:])
     upp = jnp.broadcast_to(upper, batch_shape + upper.shape[-2:])
 
+    from ..parallel.mesh import active_mesh
+
+    if active_mesh() is not None:
+        return _banded_solve_while(low, upp, p, q, bb, n, batch_shape)
+
     # forward substitution: y_i = b_i - sum_d L[i, i-d] y_{i-d}
     def fwd_step(carry, xs):
         b_i, l_i = xs  # (batch,), (batch, p)
@@ -123,6 +128,58 @@ def _banded_solve_moved(lower, upper, p: int, q: int, b):
     xs = (y[::-1], jnp.moveaxis(upp, -1, 0)[::-1])
     _, x_rev = jax.lax.scan(bwd_step, carry0, xs)
     x = x_rev[::-1]
+    return jnp.moveaxis(x, 0, -1)
+
+
+def _banded_solve_while(low, upp, p: int, q: int, bb, n: int, batch_shape):
+    """Substitutions as explicit ``while_loop``s with an int32 counter.
+
+    Functionally identical to the scan path above; used under an active mesh
+    because ``lax.scan``'s induction variable lowers to s64 in x64 mode, and
+    XLA's SPMD partitioner mixes it with its own s32 shard offsets inside the
+    ys ``dynamic_update_slice`` — the post-partitioning HLO verifier then
+    rejects the program ("compare with different element types: s64[] and
+    s32[]").  Explicit i32 indices keep every slice dtype consistent.  This
+    path is not reverse-differentiable (``while_loop``); sharded autodiff
+    through the implicit solves would need the scan path."""
+    dt = bb.dtype
+    batch_shape = tuple(batch_shape)
+    bb_m = jnp.moveaxis(bb, -1, 0)  # (n, *batch)
+    low_m = jnp.moveaxis(low, -1, 0)  # (n, *batch, p)
+    upp_m = jnp.moveaxis(upp, -1, 0)  # (n, *batch, q+1)
+
+    def zeros(k):
+        return tuple(jnp.zeros(batch_shape, dtype=dt) for _ in range(max(k, 1)))
+
+    # forward substitution: y_i = b_i - sum_d L[i, i-d] y_{i-d}
+    def fwd_body(state):
+        i, carry, y = state
+        b_i = jax.lax.dynamic_index_in_dim(bb_m, i, 0, keepdims=False)
+        l_i = jax.lax.dynamic_index_in_dim(low_m, i, 0, keepdims=False)
+        acc = b_i
+        for d in range(p):
+            acc = acc - l_i[..., d] * carry[d]
+        new_carry = (acc,) + carry[:-1] if p > 0 else carry
+        return i + 1, new_carry, jax.lax.dynamic_update_index_in_dim(y, acc, i, 0)
+
+    i0 = jnp.asarray(0, jnp.int32)
+    buf = jnp.zeros((n,) + batch_shape, dtype=dt)
+    _, _, y = jax.lax.while_loop(lambda s: s[0] < n, fwd_body, (i0, zeros(p), buf))
+
+    # backward substitution: x_i = (y_i - sum_d U[i, i+d] x_{i+d}) / U[i, i]
+    def bwd_body(state):
+        i, carry, x = state
+        y_i = jax.lax.dynamic_index_in_dim(y, i, 0, keepdims=False)
+        u_i = jax.lax.dynamic_index_in_dim(upp_m, i, 0, keepdims=False)
+        acc = y_i
+        for d in range(1, q + 1):
+            acc = acc - u_i[..., d] * carry[d - 1]
+        x_i = acc / u_i[..., 0]
+        new_carry = (x_i,) + carry[:-1] if q > 0 else carry
+        return i - 1, new_carry, jax.lax.dynamic_update_index_in_dim(x, x_i, i, 0)
+
+    iN = jnp.asarray(n - 1, jnp.int32)
+    _, _, x = jax.lax.while_loop(lambda s: s[0] >= 0, bwd_body, (iN, zeros(q), buf))
     return jnp.moveaxis(x, 0, -1)
 
 

@@ -606,6 +606,18 @@ class FoldedMatrix:
 
     def apply(self, a, axis: int):
         if self._cast is not None and a.dtype != self._cast:
+            if jnp.iscomplexobj(a) and not jnp.issubdtype(
+                self._cast, jnp.complexfloating
+            ):
+                # astype(real) silently DROPS the imaginary part; the hybrid
+                # cast is only defined real->real (f64 state through f32
+                # transforms).  Complex spectral data must stay complex —
+                # split-Fourier layouts reach here as real re/im planes.
+                raise TypeError(
+                    f"FoldedMatrix hybrid cast: complex operand ({a.dtype}) "
+                    f"cannot be cast to real {self._cast} without losing the "
+                    "imaginary part"
+                )
             out = self._impl.apply(self._dev, a.astype(self._cast), axis)
             return out.astype(a.dtype)
         return self._impl.apply(self._dev, a, axis)

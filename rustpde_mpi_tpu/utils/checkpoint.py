@@ -171,6 +171,77 @@ def write_snapshot(model, filename: str) -> None:
             h5.create_dataset(key, data=float(value))
 
 
+def write_ensemble_snapshot(ens, filename: str) -> None:
+    """Write a K-member ensemble snapshot: groups ``member{i}`` each holding
+    the reference single-run variable layout (:func:`write_field`), plus
+    root-level ensemble bookkeeping — ``time``, ``members``, per-member
+    ``alive`` mask and ``steps_done`` counters, physics params, and the
+    shared ``tempbc`` lift field (written once, members share it)."""
+    import h5py
+
+    os.makedirs(os.path.dirname(filename) or ".", exist_ok=True)
+    model = ens.model
+    xs, dxs = _model_coords(model)
+    with h5py.File(filename, "w") as h5:
+        for i in range(ens.k):
+            grp = h5.require_group(f"member{i}")
+            for varname, attr in _VARS:
+                space = getattr(model, f"{attr}_space")
+                write_field(grp, varname, space, getattr(ens.state, attr)[i], xs, dxs)
+        if getattr(model, "tempbc_ortho", None) is not None:
+            write_field(h5, "tempbc", model.field_space, model.tempbc_ortho, xs, dxs)
+        h5.create_dataset("time", data=float(ens.time))
+        h5.create_dataset("members", data=int(ens.k))
+        h5.create_dataset("alive", data=np.asarray(ens.mask).astype(np.int8))
+        h5.create_dataset(
+            "steps_done", data=np.asarray(ens.steps_done, dtype=np.int64)
+        )
+        for key, value in model.params.items():
+            h5.create_dataset(key, data=float(value))
+
+
+def read_ensemble_snapshot(ens, filename: str) -> None:
+    """Restore an ensemble snapshot written by :func:`write_ensemble_snapshot`.
+
+    Member count may differ from the target ensemble's — the state, mask and
+    counters are rebuilt at the file's K.  Each member goes through
+    :func:`read_field_vhat`, so per-member resolution interpolation works
+    exactly like the single-run restart path.  ``pseu`` (the pressure
+    increment, not stored — reference layout) restarts at zero."""
+    import h5py
+
+    import jax
+    import jax.numpy as jnp
+
+    from ..models.navier import NavierState
+
+    model = ens.model
+    with h5py.File(filename, "r") as h5:
+        k = int(np.asarray(h5["members"]))
+        members = []
+        for i in range(k):
+            grp = h5[f"member{i}"]
+            updates = {}
+            for varname, attr in _VARS:
+                space = getattr(model, f"{attr}_space")
+                vhat = read_field_vhat(grp, varname, space)
+                updates[attr] = jnp.asarray(vhat, dtype=space.spectral_dtype())
+            updates["pseu"] = jnp.zeros(
+                model.pseu_space.shape_spectral, model.pseu_space.spectral_dtype()
+            )
+            members.append(NavierState(**updates))
+        with model._scope():
+            ens.state = jax.tree.map(lambda *xs: jnp.stack(xs), *members)
+            ens.k = k
+            ens.mask = jnp.asarray(np.asarray(h5["alive"], dtype=bool))
+            ens.steps_done = jnp.asarray(
+                np.asarray(h5["steps_done"]), dtype=jnp.int32
+            )
+        ens.time = float(np.asarray(h5["time"]))
+    ens._obs_cache = None
+    print(f" <== {filename} ({k} members)")
+
+
 def read_snapshot(model, filename: str) -> None:
     """Restore a flow snapshot: spectral coefficients + time
     (/root/reference/src/navier_stokes/navier_io.rs:21-29)."""

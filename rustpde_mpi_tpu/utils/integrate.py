@@ -39,7 +39,13 @@ def integrate(pde, max_time: float, save_intervall: float | None = None) -> None
     whole save intervals per device dispatch — essential on TPU where every
     dispatch crosses a host relay.  Stop criteria are then evaluated at
     interval boundaries instead of every step (same observable behavior: the
-    reference only *acts* on them via prints/saves at those boundaries)."""
+    reference only *acts* on them via prints/saves at those boundaries).
+
+    Batched models degrade gracefully under this driver: a
+    :class:`~rustpde_mpi_tpu.models.ensemble.NavierEnsemble` freezes
+    individual diverged members inside its chunked step (per-member finite
+    mask) and its ``exit()`` fires only once EVERY member is dead, so the
+    loop keeps advancing the surviving members."""
     if hasattr(pde, "update_n"):
         _integrate_chunked(pde, max_time, save_intervall)
         return

@@ -77,7 +77,7 @@ def benchmark_steps(model, steps: int, warmup: int | None = None, reps: int = 3)
     slope = float(np.median(slopes))
     if slope <= 0:  # trivial model / timer noise: fall back to the naive rate
         slope = t4 / L4
-    return {
+    res = {
         "steps_per_sec": 1.0 / slope,
         "ms_per_step": 1e3 * slope,
         "fixed_overhead_ms": 1e3 * float(np.median(fixeds)),
@@ -86,6 +86,16 @@ def benchmark_steps(model, steps: int, warmup: int | None = None, reps: int = 3)
         "steps_total": executed,
         "slope_reps_ms": [round(1e3 * s, 4) for s in slopes],
     }
+    # a batched ensemble (models/ensemble.py) advances K members per step:
+    # aggregate member-steps/s is the number that compares against K solo
+    # runs (its MFU comes from mfu_estimate, whose step FLOPs carry the K
+    # factor through the vmapped jaxpr's batched dot_generals)
+    k = int(getattr(model, "ensemble_size", 0) or 0)
+    if k:
+        res["ensemble_size"] = k
+        res["member_steps_per_sec"] = k * res["steps_per_sec"]
+        res["ms_per_member_step"] = res["ms_per_step"] / k
+    return res
 
 
 class StepTimer:
@@ -208,6 +218,8 @@ def step_flops(model) -> float | None:
         )
         lowered = jax.jit(model._make_step()).lower(example)
         cost = lowered.compile().cost_analysis()
+        if isinstance(cost, (list, tuple)):  # newer jaxlib: one dict per device
+            cost = cost[0] if cost else None
         if cost and cost.get("flops"):
             return float(cost["flops"])
     except Exception:
@@ -270,7 +282,10 @@ def _analytic_step_flops(model) -> float:
                 if hasattr(fm, "flops_factor"):
                     factors.append(fm.flops_factor)
     factor = float(np.mean(factors)) if factors else (0.5 if folding_enabled() else 1.0)
-    return gemms * factor * 2.0 * n**3
+    # an ensemble's step advances K members (the jaxpr paths above count this
+    # via batched dot dims; the analytic estimate must scale explicitly)
+    k = max(1, int(getattr(model, "ensemble_size", 1) or 1))
+    return k * gemms * factor * 2.0 * n**3
 
 
 def mfu_estimate(model, steps_per_sec: float) -> dict:

@@ -79,21 +79,27 @@ def read_pencil(filename, dsname: str, decomp, rank: int, pencil: str = "y",
 def write_pencils_concurrent(
     filename, dsname: str, arr, decomp, pencil: str = "y", max_workers=None
 ) -> None:
-    """TRUE-parallel pencil writer — the TPU-native analog of the reference's
+    """Concurrent pencil writer — the TPU-native analog of the reference's
     concurrent MPIO path, which it ships disabled
     (/root/reference/src/field_mpi/io_mpi.rs:14-108 behind the off-by-default
     ``mpio`` feature; SURVEY S2 rows field_mpi::io_mpi /
     io::future_read_write_mpi_hdf5).
 
     Parallel HDF5 needs an MPI-enabled libhdf5; instead each rank-slab is
-    written CONCURRENTLY to its own shard file (``{filename}.{dsname}.shardN``
-    — independent files, no library lock to serialize on; h5py releases the
-    GIL during chunk IO, and in a real multi-host deployment each host writes
-    its own shard natively) and the main file exposes the global dataset as
-    an HDF5 *virtual dataset* over the shards — readers (``read_slice`` /
+    written to its own shard file (``{filename}.{dsname}.shardN``) from a
+    thread pool, and the main file exposes the global dataset as an HDF5
+    *virtual dataset* over the shards — readers (``read_slice`` /
     ``read_pencil`` / h5py) see the same global dataset as the sequential
-    writer produces, with zero stitching copies.  The shard files must travel
-    with the main file (HDF5 resolves them relative to it)."""
+    writer produces, with zero stitching copies.  A caveat on the in-process
+    concurrency: h5py serializes ALL HDF5 library calls behind one
+    process-wide lock, even across separate files, so the pooled shard
+    writes overlap only the main thread's fetch-ahead of the next slabs and
+    whatever the OS buffers beneath the serialized writes — the
+    single-process speedup is bounded, not Nx.  The design earns its name in
+    a multi-host deployment, where each host writes its own shard file
+    natively and only the virtual-dataset stitching is centralized.  The
+    shard files must travel with the main file (HDF5 resolves them relative
+    to it)."""
     import os
     from concurrent.futures import ThreadPoolExecutor
 
@@ -113,17 +119,32 @@ def write_pencils_concurrent(
     pencils = [get(rank) for rank in range(decomp.nprocs)]
     base = os.path.basename(filename)
 
-    def write_shard(rank_p):
-        rank, p = rank_p
-        sel = tuple(slice(st, st + s) for st, s in zip(p.st, p.sz))
-        block = np.ascontiguousarray(np.asarray(arr[sel]))
+    def write_shard(rank, block):
         shard = f"{filename}.{dsname.replace('/', '_')}.shard{rank}"
         with h5py.File(shard, "w") as f:
             f.create_dataset("slab", data=block)
         return rank, block.dtype
 
-    with ThreadPoolExecutor(max_workers=max_workers or min(8, len(pencils))) as ex:
-        dtypes = dict(ex.map(write_shard, enumerate(pencils)))
+    # slab fetches run on the MAIN thread: a sliced read of a sharded jax
+    # Array dispatches a gather computation, and concurrent dispatch from
+    # pool threads deadlocks the runtime's own thread pool (observed on the
+    # CPU backend: every worker parked inside Array.__getitem__).  Only the
+    # h5py shard writes go to the pool; in-flight slabs are bounded to the
+    # worker count so peak host memory stays O(workers) slabs.
+    workers = max_workers or min(8, len(pencils))
+    dtypes = {}
+    with ThreadPoolExecutor(max_workers=workers) as ex:
+        pending = []
+        for rank, p in enumerate(pencils):
+            sel = tuple(slice(st, st + s) for st, s in zip(p.st, p.sz))
+            block = np.ascontiguousarray(np.asarray(arr[sel]))
+            pending.append(ex.submit(write_shard, rank, block))
+            if len(pending) > workers:
+                r, dt = pending.pop(0).result()
+                dtypes[r] = dt
+        for fut in pending:
+            r, dt = fut.result()
+            dtypes[r] = dt
     layout = h5py.VirtualLayout(shape=global_shape, dtype=dtypes[0])
     for rank, p in enumerate(pencils):
         sel = tuple(slice(st, st + s) for st, s in zip(p.st, p.sz))

@@ -27,33 +27,70 @@ def main() -> int:
     env = dict(os.environ)
     if not args.fast:
         env["RUSTPDE_SLOW"] = "1"
+    tier = "fast" if args.fast else "full (RUSTPDE_SLOW=1)"
+    timeout_s = 7200
     t0 = time.time()
-    proc = subprocess.run(
-        [sys.executable, "-m", "pytest", "tests/", "-q"],
-        cwd=_REPO,
-        env=env,
-        capture_output=True,
-        text=True,
-        timeout=7200,
-    )
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-m", "pytest", "tests/", "-q"],
+            cwd=_REPO,
+            env=env,
+            capture_output=True,
+            text=True,
+            timeout=timeout_s,
+        )
+    except subprocess.TimeoutExpired as exc:
+        # a hung suite must still leave a TESTS.json entry: record the
+        # timeout (rc=124, the coreutils convention) before exiting nonzero
+        record = {
+            "tier": tier,
+            "summary": f"timeout: suite exceeded {timeout_s}s",
+            "passed": 0,
+            "failed": 0,
+            "skipped": 0,
+            "wall_s": round(time.time() - t0, 1),
+            "returncode": 124,
+            "date": _utc_now(),
+        }
+        _persist(record)
+        print(json.dumps(record))
+        out = exc.stdout
+        if isinstance(out, bytes):
+            out = out.decode(errors="replace")
+        sys.stderr.write((out or "")[-4000:])
+        return 124
     wall = time.time() - t0
     tail = (proc.stdout or "").strip().splitlines()[-1:] or [""]
     summary = tail[0]
-    counts = {kind: int(num) for num, kind in
+    # normalize "errors" -> "error" so the plural pytest summary counts too
+    counts = {kind.rstrip("s") if kind.startswith("error") else kind: int(num)
+              for num, kind in
               re.findall(r"(\d+) (passed|failed|skipped|errors?)", summary)}
     record = {
-        "tier": "fast" if args.fast else "full (RUSTPDE_SLOW=1)",
+        "tier": tier,
         "summary": summary,
         "passed": counts.get("passed", 0),
-        "failed": counts.get("failed", 0) + counts.get("error", 0)
-        + counts.get("errors", 0),
+        "failed": counts.get("failed", 0) + counts.get("error", 0),
         "skipped": counts.get("skipped", 0),
         "wall_s": round(wall, 1),
         "returncode": proc.returncode,
-        "date": datetime.datetime.now(datetime.timezone.utc).strftime(
-            "%Y-%m-%d %H:%M UTC"
-        ),
+        "date": _utc_now(),
     }
+    _persist(record)
+    print(json.dumps(record))
+    if proc.returncode != 0:
+        sys.stderr.write(proc.stdout[-4000:])
+    return proc.returncode
+
+
+def _utc_now() -> str:
+    return datetime.datetime.now(datetime.timezone.utc).strftime(
+        "%Y-%m-%d %H:%M UTC"
+    )
+
+
+def _persist(record: dict) -> None:
+    """Append ``record`` to TESTS.json (latest + last-10 history)."""
     prev = []
     path = os.path.join(_REPO, "TESTS.json")
     try:
@@ -63,10 +100,6 @@ def main() -> int:
         pass
     with open(path, "w") as f:
         json.dump({"latest": record, "history": (prev + [record])[-10:]}, f, indent=1)
-    print(json.dumps(record))
-    if proc.returncode != 0:
-        sys.stderr.write(proc.stdout[-4000:])
-    return proc.returncode
 
 
 if __name__ == "__main__":

@@ -22,6 +22,7 @@ _CASES = {
     "solve_poisson.py": [],
     "solve_hholtz.py": ["--n", "17"],
     "navier_rbc.py": ["--quick"],
+    "navier_rbc_ensemble.py": ["--quick"],
     "navier_rbc_periodic.py": ["--nx", "16", "--ny", "17", "--max-time", "0.05"],
     "navier_rbc_roughness.py": ["--quick"],
     "navier_mpi.py": ["--quick"],

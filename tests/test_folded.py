@@ -213,3 +213,16 @@ def test_banded_apply_families():
     ):
         fm = _check(mat, "banded", atol=1e-13)
         assert fm.flops_factor < 0.25
+
+
+def test_hybrid_cast_rejects_complex_input():
+    # the hybrid cast path (f64 state through f32 device transforms) is only
+    # defined real->real: astype(float32) on a complex operand would silently
+    # drop the imaginary part, so it must raise instead
+    rng = np.random.default_rng(0)
+    fm = FoldedMatrix(rng.standard_normal((8, 8)), _dev, cast=np.float32)
+    ok = fm.apply(jnp.asarray(rng.standard_normal((8, 5))), 0)
+    assert ok.dtype == jnp.float64  # output cast back to the input dtype
+    bad = jnp.asarray(rng.standard_normal((8, 5)) + 1j)
+    with pytest.raises(TypeError, match="imaginary"):
+        fm.apply(bad, 0)

@@ -120,18 +120,26 @@ def test_nan_divergence_early_exit_in_chunk():
     /root/reference/src/lib.rs:187-219): once the flow is NaN the scanned
     chunk stops stepping on device — the step counter threaded through the
     scan carry freezes at the first NaN step instead of burning the chunk."""
+    import jax
     import jax.numpy as jnp
 
     model = Navier2D.new_confined(17, 17, 1e4, 1.0, 0.01, 1.0, "rbc")
+
+    # _step_n donates its input buffers (update_n hands it a fresh copy);
+    # this private-API test must honor the same contract because it reuses
+    # model.state after the call
+    def dup(s):
+        return jax.tree.map(jnp.copy, s)
+
     # healthy state: all 64 scheduled steps execute
-    _, done = model._step_n(model.state, 64)
+    _, done = model._step_n(dup(model.state), 64)
     assert int(done) == 64
     # poison one temperature mode: the first step produces a NaN field, the
     # remaining 63 iterations take the identity branch
     bad = model.state._replace(
         temp=model.state.temp.at[(0,) * model.state.temp.ndim].set(jnp.nan)
     )
-    frozen, done = model._step_n(bad, 64)
+    frozen, done = model._step_n(dup(bad), 64)
     assert int(done) == 1
     # the driver-visible criterion fires at the next boundary
     model.state = frozen
