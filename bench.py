@@ -11,7 +11,8 @@ Environment knobs:
     RUSTPDE_BENCH_CONFIGS  comma list / "all" (default) /
                            names: rbc129, periodic, poisson1025,
                                   poisson1025_f64, rbc1025, rbc1025_f64,
-                                  sh2048, rbc2049, rbc2049_f64, rbc129_f64
+                                  sh2048, rbc2049, rbc2049_f64, rbc129_f64,
+                                  ensemble129, resilience129
     RUSTPDE_BENCH_STEPS    timed window for the primary config (default 64;
                            rates are slope-timed over windows L and 4L, see
                            utils/profiling.benchmark_steps)
@@ -60,6 +61,7 @@ DEFAULT_CONFIGS = [
     "sh2048",
     "rbc129",
     "ensemble129",
+    "resilience129",
     "periodic",
     "poisson1025",
     "poisson1025_f64",
@@ -81,6 +83,7 @@ METRIC_NAMES = {
     "rbc129": "2D RBC confined 129x129 Ra=1e7",
     "rbc129_f64": "2D RBC confined 129x129 Ra=1e7",
     "ensemble129": "2D RBC ensemble 129x129 Ra=1e7 K=1/8/32 (member-steps/s)",
+    "resilience129": "2D RBC confined 129x129 Ra=1e7 NaN-fault recovery",
     "periodic": "2D RBC periodic 128x65 Ra=1e6",
     "periodic1024": "2D RBC periodic 1024x1025 Ra=1e9",
     "poisson1025": "Poisson standalone 1025x1025",
@@ -242,6 +245,80 @@ def bench_ensemble(nx, ny, ra, dt, steps, ks=(1, 8, 32)):
         "unit_note": "steps_per_sec = aggregate member-steps/s at max K",
         "k8_vs_k1_member_rate": (k8 / k1) if (k8 and k1) else None,
         "finite": finite,
+    }
+
+
+def bench_resilience(nx, ny, ra, dt, steps):
+    """Recovery-overhead config (utils/resilience.py): the same horizon run
+    twice — once clean (plain ``integrate``), once under a
+    ``ResilientRunner`` with a NaN fault injected at the midpoint, which
+    forces anchor-checkpoint rollback + dt-backoff (solver rebuild +
+    re-jit) + a full retry at dt/2.  ``recovery_overhead_x`` is the honest
+    price of surviving a divergence (~2.5x stepping work + checkpoint IO +
+    the dt/2 recompile); the red/green gate is recovery integrity: the
+    faulted run must reach max_time with exactly one retry, a journaled
+    rollback, and finite Nu."""
+    import json as _json
+    import shutil
+    import tempfile
+
+    import numpy as np
+
+    from rustpde_mpi_tpu import Navier2D, ResilientRunner, config, integrate
+
+    config.enable_compilation_cache()
+
+    def build(dt_):
+        model = Navier2D(nx, ny, ra, 1.0, dt_, 1.0, "rbc", periodic=False)
+        model.set_velocity(0.1, 2.0, 2.0)
+        model.set_temperature(0.1, 2.0, 2.0)
+        model.write_intervall = 1e9  # no flow-snapshot churn inside the bench
+        return model
+
+    max_time = steps * dt
+    model = build(dt)
+    t0 = time.perf_counter()
+    integrate(model, max_time, None)
+    clean_s = time.perf_counter() - t0
+
+    run_dir = tempfile.mkdtemp(prefix="bench_resilience_")
+    try:
+        runner = ResilientRunner(
+            build(dt),
+            max_time,
+            None,
+            run_dir=run_dir,
+            checkpoint_every_s=None,
+            max_retries=1,
+            dt_backoff=0.5,
+            fault=f"nan@{steps // 2}",
+        )
+        t0 = time.perf_counter()
+        summary = runner.run()
+        faulted_s = time.perf_counter() - t0
+        with open(runner.journal_path, encoding="utf-8") as fh:
+            events = [_json.loads(line)["event"] for line in fh]
+    finally:
+        shutil.rmtree(run_dir, ignore_errors=True)
+
+    nu = summary["nu"]
+    recovered = bool(
+        summary["outcome"] == "done"
+        and summary["retries"] == 1
+        and "retry" in events
+        and nu is not None
+        and np.isfinite(nu)
+    )
+    return {
+        # effective forward progress including the recovery detour
+        "steps_per_sec": steps / faulted_s,
+        "clean_steps_per_sec": steps / clean_s,
+        "recovery_overhead_x": faulted_s / clean_s,
+        "retries": summary["retries"],
+        "final_dt": summary["dt"],
+        "nu": nu,
+        "steps": steps,
+        "finite": recovered,
     }
 
 
@@ -523,6 +600,11 @@ def main() -> int:
                 # short window: at K=32 each timed step is 32 member-steps,
                 # and the slope timing cancels the dispatch overhead anyway
                 r = bench_ensemble(129, 129, 1e7, 2e-3, max(8, steps // 4))
+            elif name == "resilience129":
+                # the faulted leg re-runs the horizon at dt/2 (~2.5x the
+                # stepping work) plus a recompile, so the window is capped
+                # regardless of RUSTPDE_BENCH_STEPS
+                r = bench_resilience(129, 129, 1e7, 2e-3, max(32, min(steps, 128)))
             elif name in ("rbc129_f64", "rbc1025_f64", "rbc2049_f64", "poisson1025_f64"):
                 env = dict(os.environ, RUSTPDE_X64="1")
                 import subprocess

@@ -1,0 +1,112 @@
+"""Rayleigh–Bénard convection under the resilient run harness.
+
+The long-run driver for real campaigns (utils/resilience.py): atomic rolling
+checkpoints on a wall-clock/sim-time cadence, auto-resume from the newest
+valid checkpoint, SIGTERM/SIGINT checkpoint-then-exit (safe under preemption
+— just rerun the same command to continue), divergence retry with dt
+backoff, and a JSONL journal of everything that happened.
+
+Kill it mid-flight and rerun; it picks up where the last checkpoint left
+off.  Inject failures deterministically to watch recovery work:
+
+    python examples/navier_rbc_resilient.py --quick --fault nan@40
+    RUSTPDE_FAULT=kill@60 python examples/navier_rbc_resilient.py --quick
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from rustpde_mpi_tpu import DispatchHang, DivergenceError, Navier2D, ResilientRunner
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true", help="small fast config")
+    ap.add_argument("--nx", type=int, default=None)
+    ap.add_argument("--ny", type=int, default=None)
+    ap.add_argument("--ra", type=float, default=None)
+    ap.add_argument("--dt", type=float, default=None)
+    ap.add_argument("--max-time", type=float, default=None)
+    ap.add_argument("--run-dir", default="data/resilient")
+    ap.add_argument(
+        "--ckpt-every-s", type=float, default=300.0,
+        help="wall-clock checkpoint cadence (seconds)",
+    )
+    ap.add_argument(
+        "--ckpt-every-t", type=float, default=None,
+        help="sim-time checkpoint cadence",
+    )
+    ap.add_argument("--keep", type=int, default=3, help="retention window")
+    ap.add_argument("--retries", type=int, default=3, help="divergence retries")
+    ap.add_argument(
+        "--dt-backoff", type=float, default=0.5,
+        help="dt shrink factor per divergence retry",
+    )
+    ap.add_argument(
+        "--dispatch-timeout-s", type=float, default=None,
+        help="hang watchdog deadline per device dispatch (default off)",
+    )
+    ap.add_argument(
+        "--fault", default=None,
+        help="inject a deterministic fault: nan@<step> | kill@<step> | "
+        "slow@<step> (also via RUSTPDE_FAULT)",
+    )
+    ap.add_argument(
+        "--fresh", action="store_true",
+        help="start a new campaign (no auto-resume); refuses to run if "
+        "--run-dir still holds a previous campaign's checkpoints",
+    )
+    ap.add_argument("--mesh", action="store_true", help="pencil-shard over all devices")
+    args = ap.parse_args()
+
+    if args.quick:
+        nx, ny, ra, dt, max_time, save = 33, 33, 1e5, 0.01, 1.0, 0.25
+    else:
+        nx, ny, ra, dt, max_time, save = 129, 129, 1e7, 2e-3, 10.0, 1.0
+    nx = args.nx or nx
+    ny = args.ny or ny
+    ra = args.ra or ra
+    dt = args.dt or dt
+    max_time = args.max_time or max_time
+
+    mesh = None
+    if args.mesh:
+        from rustpde_mpi_tpu.parallel import make_mesh
+
+        mesh = make_mesh()
+
+    model = Navier2D.new_confined(nx, ny, ra, 1.0, dt, 1.0, "rbc", mesh=mesh)
+    runner = ResilientRunner(
+        model,
+        max_time=max_time,
+        save_intervall=save,
+        run_dir=args.run_dir,
+        checkpoint_every_s=args.ckpt_every_s,
+        checkpoint_every_t=args.ckpt_every_t,
+        keep=args.keep,
+        max_retries=args.retries,
+        dt_backoff=args.dt_backoff,
+        dispatch_timeout_s=args.dispatch_timeout_s,
+        fault=args.fault,
+        resume=not args.fresh,
+    )
+    try:
+        summary = runner.run()
+    except DivergenceError as exc:
+        print(f"unrecoverable divergence: {exc}")
+        return 2
+    except DispatchHang as exc:
+        print(f"dispatch hang: {exc}")
+        return 3
+    print(json.dumps(summary))
+    # "preempted" is a clean exit: the checkpoint is on disk, rerunning the
+    # same command resumes the campaign
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

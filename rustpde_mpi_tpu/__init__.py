@@ -35,6 +35,11 @@ from .models.statistics import Statistics  # noqa: F401
 from .models.steady_adjoint import Navier2DAdjoint  # noqa: F401
 from .models.swift_hohenberg import SwiftHohenberg1D, SwiftHohenberg2D  # noqa: F401
 from .utils.integrate import Integrate, integrate  # noqa: F401
+from .utils.resilience import (  # noqa: F401
+    DispatchHang,
+    DivergenceError,
+    ResilientRunner,
+)
 from .utils.vorticity import (  # noqa: F401
     vorticity_auto,
     vorticity_from_file,

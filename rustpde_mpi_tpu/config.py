@@ -120,6 +120,30 @@ from dataclasses import dataclass, field
 
 
 @dataclass
+class ResilienceConfig:
+    """Knobs for :class:`~rustpde_mpi_tpu.utils.resilience.ResilientRunner`
+    (field names match the runner's keyword arguments; build one via
+    ``ResilientRunner.from_config(pde, cfg.resilience, max_time)``).
+
+    ``checkpoint_every_s``/``checkpoint_every_t`` are the wall-clock and
+    sim-time checkpoint cadences (either may be None); ``keep`` is the
+    rolling retention window; ``dt_backoff`` is the divergence-retry step
+    shrink factor; ``dispatch_timeout_s`` arms the device-dispatch hang
+    watchdog (None = RUSTPDE_DISPATCH_TIMEOUT_S env, unset = off)."""
+
+    run_dir: str = "data/resilient"
+    checkpoint_every_s: float | None = 300.0
+    checkpoint_every_t: float | None = None
+    keep: int = 3
+    max_retries: int = 3
+    dt_backoff: float = 0.5
+    respawn_members: bool = False
+    respawn_amp: float = 1e-3
+    dispatch_timeout_s: float | None = None
+    resume: bool = True
+
+
+@dataclass
 class NavierConfig:
     """Configuration dataclass for the Navier models (SURVEY.md S5: the
     reference passes bare constructor arguments and mutates public fields,
@@ -143,6 +167,9 @@ class NavierConfig:
     # member count for NavierEnsemble.from_config (1 = plain single run);
     # members share the operator constants and differ by IC seed
     ensemble: int = 1
+    # resilience-harness knobs (None = run without the harness; see
+    # ResilienceConfig / utils/resilience.ResilientRunner)
+    resilience: ResilienceConfig | None = None
 
     def ctor_args(self) -> tuple:
         return (self.nx, self.ny, self.ra, self.pr, self.dt, self.aspect, self.bc)

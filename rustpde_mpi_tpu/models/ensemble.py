@@ -172,6 +172,55 @@ class NavierEnsemble(Integrate):
         step_cc = model._step_cc
         obs_cc = model._obs_cc
 
+        if model._gspmd_split_sep_fallback():
+            # same poisoned layout the single-run guard reroutes (fused
+            # split-sep periodic step miscompiled by GSPMD under a mesh): a
+            # jitted vmap of step_cc would compile the SAME fused program,
+            # and an eager vmap trips with_sharding_constraint on batch
+            # tracers — so members step per-member through the eager path
+            # proven correct for the single run.  Slow but right; the
+            # per-member freeze semantics (keep the last FINITE state, stop
+            # counting) are preserved.
+            step_fn = model._make_step()
+            obs_fn = model._make_observables()
+
+            def ens_step_n_eager(states, mask, done, n):
+                alive = np.asarray(mask).copy()
+                counts = np.asarray(done).copy()
+                members = [
+                    jax.tree.map(lambda x, i=i: x[i], states) for i in range(self.k)
+                ]
+                for i in range(self.k):
+                    if not alive[i]:
+                        continue
+                    st = members[i]
+                    for _ in range(int(n)):
+                        cand = step_fn(st)
+                        if bool(jnp.isfinite(jnp.sum(cand.temp))):
+                            st = cand
+                            counts[i] += 1
+                        else:
+                            alive[i] = False
+                            break
+                    members[i] = st
+                stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *members)
+                return (
+                    stacked,
+                    jnp.asarray(alive),
+                    jnp.asarray(counts, dtype=jnp.int32),
+                )
+
+            def obs_eager(states):
+                outs = [
+                    obs_fn(jax.tree.map(lambda x, i=i: x[i], states))
+                    for i in range(self.k)
+                ]
+                return tuple(jnp.stack(vals) for vals in zip(*outs))
+
+            self._step_n = ens_step_n_eager
+            self._obs_fn = obs_eager
+            return
+
         def ens_step_n(consts, states, mask, done, n: int):
             """n vmapped steps with per-member fault isolation: the carry
             holds (states, alive-mask, per-member step counters).  An alive
@@ -252,8 +301,57 @@ class NavierEnsemble(Integrate):
     def get_dt(self) -> float:
         return self.dt
 
+    def set_dt(self, dt: float) -> None:
+        """Propagate a dt change (divergence-retry backoff) through the
+        shared template model — which rebuilds its dt-baked solvers and
+        re-traces ``_step_cc`` — then re-vmap the ensemble entry points on
+        top of the new jaxpr.  Member states are untouched."""
+        self.model.set_dt(dt)
+        self.dt = self.model.dt
+        self._compile_entry_points()
+        self._obs_cache = None
+
     def reset_time(self) -> None:
         self.time = 0.0
+
+    def respawn_dead(self, amp: float = 1e-3, seed: int | None = None) -> int:
+        """Re-seed every dead member from a perturbed healthy donor instead
+        of leaving it frozen forever (utils/resilience.py calls this at
+        rollback when ``respawn_members`` is on).
+
+        Each dead member receives a healthy member's state with a small
+        multiplicative spectral perturbation (``coeff * (1 + amp*noise)``) —
+        enough to decorrelate the respawned trajectory without restarting
+        the transient from scratch.  Donors round-robin over the healthy
+        members; surviving members' states are NOT touched (their buffers
+        are updated per-index, ``set_member``).  Returns the number of
+        members respawned (0 when all alive or none alive — with no healthy
+        donor there is nothing to copy from)."""
+        alive = self.alive()
+        if alive.all() or not alive.any():
+            return 0
+        rng = np.random.default_rng(seed)
+        donors = np.flatnonzero(alive)
+        respawned = 0
+        for i in np.flatnonzero(~alive):
+            donor = int(donors[respawned % len(donors)])
+            state = self.member_state(donor)
+            with self.model._scope():
+                perturbed = jax.tree.map(
+                    lambda x: x
+                    * (
+                        1.0
+                        + amp
+                        * jnp.asarray(
+                            rng.standard_normal(x.shape),
+                            dtype=jnp.real(x).dtype,
+                        )
+                    ),
+                    state,
+                )
+            self.set_member(int(i), perturbed)
+            respawned += 1
+        return respawned
 
     def alive(self) -> np.ndarray:
         """Per-member alive mask as a host bool array of shape (K,)."""

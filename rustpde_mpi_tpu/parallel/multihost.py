@@ -154,11 +154,41 @@ def host_local_array(arr: jax.Array, spec: tuple | None = None) -> np.ndarray:
     )
 
 
+def broadcast(value, is_source: bool | None = None):
+    """Root-decides broadcast of a small host value (the preemption/rollback
+    handshake in utils/resilience.py: rank 0 decides, every host acts on the
+    same decision).  Identity on a single host; returns a numpy value."""
+    if jax.process_count() == 1:
+        return np.asarray(value)
+    from jax.experimental import multihost_utils
+
+    return multihost_utils.broadcast_one_to_all(
+        np.asarray(value), is_source=is_source
+    )
+
+
 def sync_hosts(tag: str = "barrier") -> None:
     """Cross-host barrier (the reference's MPI barrier,
-    src/field_mpi/io_mpi_sequ.rs:46); no-op single-host."""
+    src/field_mpi/io_mpi_sequ.rs:46); no-op single-host.
+
+    ``sync_global_devices`` blocks FOREVER if a peer host died (the silent
+    job-wide hang that ate PR 1's tier-1 budget).  ``RUSTPDE_SYNC_TIMEOUT_S``
+    (default off) arms a watchdog: after the deadline every thread's stack is
+    dumped to stderr together with the barrier tag, and a structured
+    :class:`~rustpde_mpi_tpu.utils.resilience.DispatchHang` is raised so the
+    scheduler sees a crash it can restart instead of a wedged job."""
     if jax.process_count() == 1:
         return
     from jax.experimental import multihost_utils
 
-    multihost_utils.sync_global_devices(tag)
+    timeout = float(os.environ.get("RUSTPDE_SYNC_TIMEOUT_S", "0") or 0.0)
+    if timeout <= 0:
+        multihost_utils.sync_global_devices(tag)
+        return
+    from ..utils.resilience import call_with_watchdog
+
+    call_with_watchdog(
+        lambda: multihost_utils.sync_global_devices(tag),
+        timeout,
+        label=f"sync_hosts({tag!r})",
+    )

@@ -18,11 +18,28 @@ One deliberate fix over the reference: the reference writes the coordinate
 array into both the ``x`` and ``dx`` datasets (field/io.rs:96-99); here ``dx``
 holds the actual grid deltas.  Readers that only consume ``x``/``y``/``v``
 (the plot/ scripts, xmf generator) see identical layout.
+
+Durability (utils/resilience.py rides on these guarantees):
+
+* every snapshot writer is **atomic**: the file is written to
+  ``<name>.<pid>.tmp``, flushed + fsynced, then ``os.replace``d over the
+  target — a crash/preemption mid-write can never truncate a previously
+  valid checkpoint,
+* files are stamped with root attrs ``digest`` (sha256 over every dataset's
+  path/shape/dtype/bytes), ``schema``, ``step`` and ``time``; readers verify
+  the digest before restoring state,
+* malformed/truncated files surface as :class:`CheckpointError` naming the
+  file and the missing group/dataset (instead of a bare ``KeyError`` /
+  h5py ``OSError``), which is what :func:`latest_checkpoint`'s
+  skip-corrupt-files logic catches,
+* :func:`rotate_checkpoints` keeps a rolling retention window.
 """
 
 from __future__ import annotations
 
+import hashlib
 import os
+from contextlib import contextmanager
 
 import numpy as np
 
@@ -30,6 +47,213 @@ from ..bases import BaseKind, Space2
 from ..field import grid_deltas
 
 _VARS = (("ux", "velx"), ("uy", "vely"), ("temp", "temp"), ("pres", "pres"))
+
+#: bump when the on-disk layout changes incompatibly; readers accept files
+#: without the attr (pre-resilience snapshots) unchanged
+SCHEMA_VERSION = 1
+
+_CKPT_PREFIX = "ckpt_"
+_CKPT_SUFFIX = ".h5"
+
+
+class CheckpointError(RuntimeError):
+    """A checkpoint file is malformed, truncated or corrupt.
+
+    Carries the offending ``filename`` and a cause message naming the missing
+    group/dataset or the failed integrity check, so restart logic
+    (utils/resilience.latest_checkpoint skip path, Navier2D.read_unwrap) has
+    one typed error to catch instead of bare ``KeyError``/``OSError``.
+    """
+
+    def __init__(self, filename: str, message: str):
+        super().__init__(f"{filename}: {message}")
+        self.filename = filename
+
+
+def content_digest(h5) -> str:
+    """sha256 over every dataset (path + shape + dtype + raw bytes, visited
+    in sorted path order).  Root *attrs* are deliberately excluded so the
+    digest can be stored as one."""
+    import h5py
+
+    paths: list[str] = []
+
+    def visit(name, obj):
+        if isinstance(obj, h5py.Dataset):
+            paths.append(name)
+
+    h5.visititems(visit)
+    digest = hashlib.sha256()
+    for name in sorted(paths):
+        data = np.ascontiguousarray(h5[name][()])
+        digest.update(name.encode("utf-8") + b"\0")
+        digest.update(str(data.dtype).encode() + b"\0")
+        digest.update(str(data.shape).encode() + b"\0")
+        digest.update(data.tobytes())
+    return digest.hexdigest()
+
+
+def _attrs_of(h5) -> dict:
+    return {
+        key: (val.decode() if isinstance(val, bytes) else val)
+        for key, val in h5.attrs.items()
+    }
+
+
+def _verify_open_file(h5, filename: str) -> dict:
+    """Digest-check an open file; returns its root attrs (digest-less files
+    — pre-resilience snapshots — pass through unverified)."""
+    attrs = _attrs_of(h5)
+    stored = attrs.get("digest")
+    if stored is not None and content_digest(h5) != stored:
+        raise CheckpointError(
+            filename,
+            "content digest mismatch (bit rot or a partially copied file)",
+        )
+    return attrs
+
+
+@contextmanager
+def _open_checkpoint(filename: str):
+    """Open a snapshot for reading with the error contract every reader
+    shares: h5py's bare ``OSError`` (truncated/partial/not-HDF5) and any
+    unhandled ``KeyError`` (missing root dataset) surface as
+    :class:`CheckpointError` naming the file."""
+    import h5py
+
+    try:
+        with h5py.File(filename, "r") as h5:
+            yield h5
+    except CheckpointError:
+        raise
+    except KeyError as exc:
+        raise CheckpointError(
+            filename, f"missing root dataset {exc.args[0]!r}"
+        ) from exc
+    except OSError as exc:
+        raise CheckpointError(
+            filename,
+            f"unreadable HDF5 file (likely a truncated/partial write): {exc}",
+        ) from exc
+
+
+def read_attrs(filename: str) -> dict:
+    """Root attrs of a snapshot WITHOUT the digest pass (cheap metadata
+    lookup for files something else already verified — resume/rollback use
+    this after :func:`latest_checkpoint` has digest-checked the file)."""
+    with _open_checkpoint(filename) as h5:
+        return _attrs_of(h5)
+
+
+def verify_snapshot(filename: str) -> dict:
+    """Open + digest-verify a snapshot; returns its root attrs.
+
+    Raises :class:`CheckpointError` when the file is unreadable (truncated
+    write, not HDF5) or its content hash does not match the stored digest."""
+    with _open_checkpoint(filename) as h5:
+        return _verify_open_file(h5, filename)
+
+
+def _atomic_h5_write(
+    filename: str,
+    body,
+    step: int | None = None,
+    time: float | None = None,
+    dt: float | None = None,
+) -> None:
+    """Write an HDF5 file atomically: ``body(h5)`` fills a ``.tmp`` sibling,
+    root attrs (schema/step/time + content digest) are stamped, the file is
+    flushed + fsynced, then ``os.replace``d over the target (and the
+    directory fsynced) — no code path can leave a truncated file where a
+    previously valid checkpoint existed."""
+    import h5py
+
+    dirname = os.path.dirname(filename) or "."
+    os.makedirs(dirname, exist_ok=True)
+    tmp = f"{filename}.{os.getpid()}.tmp"
+    try:
+        with h5py.File(tmp, "w") as h5:
+            body(h5)
+            h5.attrs["schema"] = SCHEMA_VERSION
+            if step is not None:
+                h5.attrs["step"] = int(step)
+            if time is not None:
+                h5.attrs["time"] = float(time)
+            if dt is not None:
+                # the step size the run was using — resume restores it so a
+                # backed-off dt survives preemption (utils/resilience.py)
+                h5.attrs["dt"] = float(dt)
+            h5.attrs["digest"] = content_digest(h5)
+            h5.flush()
+        fd = os.open(tmp, os.O_RDONLY)
+        try:
+            os.fsync(fd)
+        finally:
+            os.close(fd)
+        os.replace(tmp, filename)
+        dfd = os.open(dirname, os.O_RDONLY)
+        try:
+            os.fsync(dfd)
+        finally:
+            os.close(dfd)
+    finally:
+        if os.path.exists(tmp):
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
+
+
+def checkpoint_path(run_dir: str, step: int) -> str:
+    """Canonical rolling-checkpoint name: ``<run_dir>/ckpt_<step:010d>.h5``
+    (name-sortable by step)."""
+    return os.path.join(run_dir, f"{_CKPT_PREFIX}{int(step):010d}{_CKPT_SUFFIX}")
+
+
+def checkpoint_files(run_dir: str) -> list[str]:
+    """All rolling checkpoints in ``run_dir``, oldest first (by step-encoded
+    name); ``.tmp`` leftovers from interrupted writes are excluded."""
+    try:
+        names = os.listdir(run_dir)
+    except OSError:
+        return []
+    return [
+        os.path.join(run_dir, n)
+        for n in sorted(names)
+        if n.startswith(_CKPT_PREFIX) and n.endswith(_CKPT_SUFFIX)
+    ]
+
+
+def latest_checkpoint(run_dir: str) -> str | None:
+    """Newest checkpoint in ``run_dir`` that passes digest verification.
+
+    Corrupt/partial files (a crash mid-copy, bit rot) are skipped with a
+    warning — resume logic falls back to the previous valid checkpoint
+    instead of dying on the newest file."""
+    for path in reversed(checkpoint_files(run_dir)):
+        try:
+            verify_snapshot(path)
+        except CheckpointError as exc:
+            print(f"skipping corrupt checkpoint: {exc}")
+            continue
+        return path
+    return None
+
+
+def rotate_checkpoints(run_dir: str, keep: int) -> list[str]:
+    """Prune the rolling window to the newest ``keep`` checkpoints; returns
+    the removed paths.  ``keep <= 0`` disables retention."""
+    removed = []
+    if keep <= 0:
+        return removed
+    files = checkpoint_files(run_dir)
+    for path in files[:-keep] if len(files) > keep else []:
+        try:
+            os.remove(path)
+            removed.append(path)
+        except OSError:
+            pass
+    return removed
 
 
 def _write_array(group, name: str, data: np.ndarray) -> None:
@@ -42,10 +266,25 @@ def _write_array(group, name: str, data: np.ndarray) -> None:
     group.create_dataset(name, data=np.asarray(data, dtype=np.float64))
 
 
+def _missing(group, name: str) -> CheckpointError:
+    filename = getattr(getattr(group, "file", None), "filename", "<h5>")
+    where = f"{group.name.rstrip('/')}/{name}"
+    return CheckpointError(
+        filename,
+        f"missing group/dataset {where!r} — truncated write or a file that "
+        "is not a snapshot in this layout",
+    )
+
+
 def _read_array(group, name: str, is_complex: bool) -> np.ndarray:
-    if is_complex:
-        return np.asarray(group[f"{name}_re"]) + 1j * np.asarray(group[f"{name}_im"])
-    return np.asarray(group[name])
+    try:
+        if is_complex:
+            return np.asarray(group[f"{name}_re"]) + 1j * np.asarray(
+                group[f"{name}_im"]
+            )
+        return np.asarray(group[name])
+    except KeyError as exc:
+        raise _missing(group, f"{name}_re/_im" if is_complex else name) from exc
 
 
 def interpolate_2d(
@@ -111,8 +350,14 @@ def read_field_vhat(h5, varname: str, space: Space2) -> np.ndarray:
     """Read one field's spectral coefficients, interpolating on mismatch.
 
     Files always carry the complex convention for periodic axes; a split
-    target space converts after the (complex-domain) interpolation."""
-    grp = h5[varname]
+    target space converts after the (complex-domain) interpolation.
+
+    A missing group/dataset raises :class:`CheckpointError` naming the file
+    and what was expected (the corrupt-checkpoint skip logic catches it)."""
+    try:
+        grp = h5[varname]
+    except KeyError as exc:
+        raise _missing(h5, varname) from exc
     split = space.bases[0].kind.is_split
     is_complex = space.spectral_is_complex or split
     data = _read_array(grp, "vhat", is_complex)
@@ -154,13 +399,15 @@ def _model_coords(model):
     return xs, dxs
 
 
-def write_snapshot(model, filename: str) -> None:
-    """Write a flow snapshot (/root/reference/src/navier_stokes/navier_io.rs:44-62)."""
-    import h5py
+def write_snapshot(model, filename: str, step: int | None = None) -> None:
+    """Write a flow snapshot (/root/reference/src/navier_stokes/navier_io.rs:44-62).
 
-    os.makedirs(os.path.dirname(filename) or ".", exist_ok=True)
+    Atomic (tmp + fsync + ``os.replace``) and digest-stamped; ``step`` is an
+    optional run-step counter recorded as a root attr for resume logic."""
+
     xs, dxs = _model_coords(model)
-    with h5py.File(filename, "w") as h5:
+
+    def body(h5):
         for varname, attr in _VARS:
             space = getattr(model, f"{attr}_space")
             write_field(h5, varname, space, getattr(model.state, attr), xs, dxs)
@@ -170,19 +417,23 @@ def write_snapshot(model, filename: str) -> None:
         for key, value in model.params.items():
             h5.create_dataset(key, data=float(value))
 
+    _atomic_h5_write(
+        filename, body, step=step, time=float(model.time), dt=float(model.dt)
+    )
 
-def write_ensemble_snapshot(ens, filename: str) -> None:
+
+def write_ensemble_snapshot(ens, filename: str, step: int | None = None) -> None:
     """Write a K-member ensemble snapshot: groups ``member{i}`` each holding
     the reference single-run variable layout (:func:`write_field`), plus
     root-level ensemble bookkeeping — ``time``, ``members``, per-member
     ``alive`` mask and ``steps_done`` counters, physics params, and the
-    shared ``tempbc`` lift field (written once, members share it)."""
-    import h5py
+    shared ``tempbc`` lift field (written once, members share it).  Atomic
+    and digest-stamped like :func:`write_snapshot`."""
 
-    os.makedirs(os.path.dirname(filename) or ".", exist_ok=True)
     model = ens.model
     xs, dxs = _model_coords(model)
-    with h5py.File(filename, "w") as h5:
+
+    def body(h5):
         for i in range(ens.k):
             grp = h5.require_group(f"member{i}")
             for varname, attr in _VARS:
@@ -199,6 +450,10 @@ def write_ensemble_snapshot(ens, filename: str) -> None:
         for key, value in model.params.items():
             h5.create_dataset(key, data=float(value))
 
+    _atomic_h5_write(
+        filename, body, step=step, time=float(ens.time), dt=float(ens.dt)
+    )
+
 
 def read_ensemble_snapshot(ens, filename: str) -> None:
     """Restore an ensemble snapshot written by :func:`write_ensemble_snapshot`.
@@ -208,19 +463,21 @@ def read_ensemble_snapshot(ens, filename: str) -> None:
     :func:`read_field_vhat`, so per-member resolution interpolation works
     exactly like the single-run restart path.  ``pseu`` (the pressure
     increment, not stored — reference layout) restarts at zero."""
-    import h5py
-
     import jax
     import jax.numpy as jnp
 
     from ..models.navier import NavierState
 
     model = ens.model
-    with h5py.File(filename, "r") as h5:
+    with _open_checkpoint(filename) as h5:
+        _verify_open_file(h5, filename)
         k = int(np.asarray(h5["members"]))
         members = []
         for i in range(k):
-            grp = h5[f"member{i}"]
+            try:
+                grp = h5[f"member{i}"]
+            except KeyError as exc:
+                raise _missing(h5, f"member{i}") from exc
             updates = {}
             for varname, attr in _VARS:
                 space = getattr(model, f"{attr}_space")
@@ -244,12 +501,13 @@ def read_ensemble_snapshot(ens, filename: str) -> None:
 
 def read_snapshot(model, filename: str) -> None:
     """Restore a flow snapshot: spectral coefficients + time
-    (/root/reference/src/navier_stokes/navier_io.rs:21-29)."""
-    import h5py
-
+    (/root/reference/src/navier_stokes/navier_io.rs:21-29).  Digest-verified
+    when the file carries one; malformed files raise
+    :class:`CheckpointError`."""
     import jax.numpy as jnp
 
-    with h5py.File(filename, "r") as h5:
+    with _open_checkpoint(filename) as h5:
+        _verify_open_file(h5, filename)
         updates = {}
         for varname, attr in _VARS:
             space = getattr(model, f"{attr}_space")

@@ -5,6 +5,20 @@ Rebuild of the reference's ``Integrate`` trait + ``integrate`` free function
 three stop criteria) are preserved; models may additionally advance many
 steps per host round-trip via ``lax.scan`` inside their ``update`` (the
 TPU-friendly path) — the driver only sees wall-clock-relevant boundaries.
+
+The driver returns a status string and accepts two hooks (both default to
+the plain behavior) so a supervising harness — the resilient runner in
+``utils/resilience.py`` — can wrap dispatches and act at chunk boundaries
+without forking the loop:
+
+* ``dispatch(pde, n)`` replaces the raw ``pde.update_n(n)`` / ``pde.update()``
+  call (watchdog deadlines, fault injection),
+* ``on_chunk(pde)`` runs after each chunk's callback/exit checks; returning
+  truthy stops the loop with status ``"stopped"`` (checkpoint cadence,
+  preemption signals).
+
+Statuses: ``"time_limit"`` | ``"timestep_limit"`` | ``"break"`` (the model's
+``exit()`` fired, e.g. NaN divergence) | ``"stopped"`` (``on_chunk`` asked).
 """
 
 from __future__ import annotations
@@ -31,9 +45,17 @@ class Integrate:
         return False
 
 
-def integrate(pde, max_time: float, save_intervall: float | None = None) -> None:
+def integrate(
+    pde,
+    max_time: float,
+    save_intervall: float | None = None,
+    *,
+    dispatch=None,
+    on_chunk=None,
+) -> str:
     """Advance ``pde`` until ``max_time``; invoke ``pde.callback()`` whenever
-    the time lands inside a half-dt window around a save interval.
+    the time lands inside a half-dt window around a save interval.  Returns
+    the stop status (module docstring).
 
     Models exposing ``update_n`` (the jitted ``lax.scan`` fast path) advance
     whole save intervals per device dispatch — essential on TPU where every
@@ -47,12 +69,14 @@ def integrate(pde, max_time: float, save_intervall: float | None = None) -> None
     mask) and its ``exit()`` fires only once EVERY member is dead, so the
     loop keeps advancing the surviving members."""
     if hasattr(pde, "update_n"):
-        _integrate_chunked(pde, max_time, save_intervall)
-        return
+        return _integrate_chunked(pde, max_time, save_intervall, dispatch, on_chunk)
     timestep = 0
     eps_dt = pde.get_dt() * 1e-4
     while True:
-        pde.update()
+        if dispatch is not None:
+            dispatch(pde, 1)
+        else:
+            pde.update()
         timestep += 1
 
         if save_intervall is not None:
@@ -62,26 +86,34 @@ def integrate(pde, max_time: float, save_intervall: float | None = None) -> None
 
         if pde.get_time() + eps_dt >= max_time:
             print(f"time limit reached: {pde.get_time()}")
-            break
+            return "time_limit"
         if timestep >= MAX_TIMESTEP:
             print(f"timestep limit reached: {timestep}")
-            break
+            return "timestep_limit"
         if pde.exit():
             print("break criteria triggered")
-            break
+            return "break"
+        if on_chunk is not None and on_chunk(pde):
+            return "stopped"
 
 
-def _integrate_chunked(pde, max_time: float, save_intervall: float | None) -> None:
+def _integrate_chunked(
+    pde, max_time: float, save_intervall: float | None, dispatch=None, on_chunk=None
+) -> str:
     """Chunked driver: one ``update_n`` dispatch per save interval.
 
     Each chunk aims at the next *absolute* save boundary (k * save_intervall)
     so callback times never drift, and the callback only fires when the time
     actually lands in the reference's half-dt save window."""
-    dt = pde.get_dt()
-    eps_dt = dt * 1e-4
     timestep = 0
-    while pde.get_time() + eps_dt < max_time:
+    while True:
+        # re-read dt every chunk: a supervising on_chunk/retry harness may
+        # have shrunk it (set_dt) since the last boundary
+        dt = pde.get_dt()
+        eps_dt = dt * 1e-4
         t = pde.get_time()
+        if t + eps_dt >= max_time:
+            break
         if save_intervall is not None:
             # next boundary strictly after t (half-dt tolerance so a chunk
             # that just landed on a boundary targets the following one)
@@ -93,7 +125,10 @@ def _integrate_chunked(pde, max_time: float, save_intervall: float | None) -> No
             target = max_time
         n = max(1, round((target - t) / dt))
         n = min(n, MAX_TIMESTEP - timestep)
-        pde.update_n(n)
+        if dispatch is not None:
+            dispatch(pde, n)
+        else:
+            pde.update_n(n)
         timestep += n
         if save_intervall is not None:
             t_new = pde.get_time()
@@ -102,8 +137,13 @@ def _integrate_chunked(pde, max_time: float, save_intervall: float | None) -> No
                 pde.callback()
         if timestep >= MAX_TIMESTEP:
             print(f"timestep limit reached: {timestep}")
-            return
+            return "timestep_limit"
         if pde.exit():
             print("break criteria triggered")
-            return
+            return "break"
+        if pde.get_time() + eps_dt >= max_time:
+            break  # completed: the time limit beats a late stop request
+        if on_chunk is not None and on_chunk(pde):
+            return "stopped"
     print(f"time limit reached: {pde.get_time()}")
+    return "time_limit"

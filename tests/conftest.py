@@ -32,11 +32,31 @@ from rustpde_mpi_tpu import config as _rp_config  # noqa: E402
 
 _rp_config.enable_compilation_cache()
 
+# Pre-kill stack dump: the tier-1 driver runs `timeout -k 10 870 pytest ...`,
+# and a single silent in-test hang (PR 1's pencil-writer deadlock) turns the
+# whole run into an unexplained rc=124.  Arm faulthandler to dump every
+# thread's stack shortly BEFORE that kill fires so the log names the hang.
+# RUSTPDE_TEST_TRACEBACK_S overrides the deadline; 0 disables.  The full
+# tier (RUSTPDE_SLOW=1) legitimately runs past any tier-1 deadline, so the
+# timer is only armed for the default selection unless explicitly requested.
+import faulthandler  # noqa: E402
+
+_DUMP_AFTER_S = float(
+    os.environ.get("RUSTPDE_TEST_TRACEBACK_S")
+    or ("0" if os.environ.get("RUSTPDE_SLOW") == "1" else "840")
+)
+if _DUMP_AFTER_S > 0:
+    faulthandler.dump_traceback_later(_DUMP_AFTER_S, exit=False)
+
 
 def pytest_configure(config):
     config.addinivalue_line(
         "markers", "slow: heavyweight end-to-end test (skipped unless RUSTPDE_SLOW=1 or -m slow)"
     )
+
+
+def pytest_sessionfinish(session, exitstatus):
+    faulthandler.cancel_dump_traceback_later()
 
 
 def pytest_collection_modifyitems(config, items):

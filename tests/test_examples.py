@@ -1,6 +1,6 @@
 """Smoke-run every example program (VERDICT r2 next #8).
 
-Each of the 13 entry points runs in a subprocess on tiny grids (CPU forced
+Each of the 14 entry points runs in a subprocess on tiny grids (CPU forced
 the same way tests/conftest.py does it) and must exit 0 — so the example
 layer can't rot while only the models it wraps are tested.
 """
@@ -24,6 +24,9 @@ _CASES = {
     "navier_rbc.py": ["--quick"],
     "navier_rbc_ensemble.py": ["--quick"],
     "navier_rbc_periodic.py": ["--nx", "16", "--ny", "17", "--max-time", "0.05"],
+    "navier_rbc_resilient.py": [
+        "--quick", "--max-time", "0.2", "--fault", "nan@8", "--retries", "1",
+    ],
     "navier_rbc_roughness.py": ["--quick"],
     "navier_mpi.py": ["--quick"],
     "navier_rbc_steady.py": ["--quick"],
