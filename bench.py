@@ -12,7 +12,7 @@ Environment knobs:
                            names: rbc129, periodic, poisson1025,
                                   poisson1025_f64, rbc1025, rbc1025_f64,
                                   sh2048, rbc2049, rbc2049_f64, rbc129_f64,
-                                  ensemble129, resilience129
+                                  ensemble129, resilience129, governor129
     RUSTPDE_BENCH_STEPS    timed window for the primary config (default 64;
                            rates are slope-timed over windows L and 4L, see
                            utils/profiling.benchmark_steps)
@@ -62,6 +62,7 @@ DEFAULT_CONFIGS = [
     "rbc129",
     "ensemble129",
     "resilience129",
+    "governor129",
     "periodic",
     "poisson1025",
     "poisson1025_f64",
@@ -84,6 +85,7 @@ METRIC_NAMES = {
     "rbc129_f64": "2D RBC confined 129x129 Ra=1e7",
     "ensemble129": "2D RBC ensemble 129x129 Ra=1e7 K=1/8/32 (member-steps/s)",
     "resilience129": "2D RBC confined 129x129 Ra=1e7 NaN-fault recovery",
+    "governor129": "2D RBC confined 129x129 Ra=1e7 stability governor (sentinel overhead + spike catch)",
     "periodic": "2D RBC periodic 128x65 Ra=1e6",
     "periodic1024": "2D RBC periodic 1024x1025 Ra=1e9",
     "poisson1025": "Poisson standalone 1025x1025",
@@ -245,6 +247,167 @@ def bench_ensemble(nx, ny, ra, dt, steps, ks=(1, 8, 32)):
         "unit_note": "steps_per_sec = aggregate member-steps/s at max K",
         "k8_vs_k1_member_rate": (k8 / k1) if (k8 and k1) else None,
         "finite": finite,
+    }
+
+
+def bench_governor(nx, ny, ra, dt, steps):
+    """Stability-governor config (utils/governor.py), two legs:
+
+    (1) **sentinel overhead** — the same slope-timed window stepped by the
+    plain chain and by the sentinel-armed chain (on-device CFL/KE/|div|
+    reductions riding the scan carry).  Gate: <5% per-chunk overhead — the
+    sentinels only reduce arrays the step already materializes.  Min-of-reps
+    slopes (not medians): host noise on a shared box dwarfs the real delta.
+
+    (2) **spike recovery** — a deterministic velocity spike at the midpoint
+    (``spike@<step>``), sized from the measured baseline CFL so the spiked
+    flow lands ~3x over the target.  Governed: the pre-divergence sentinel
+    catches it BEFORE NaNs, rollback happens in memory, dt descends the
+    rung-cached ladder, and the run finishes with ZERO reactive checkpoint
+    restores.  Ungoverned: the same spike grows into NaN and needs the
+    checkpoint-rollback path.  Red/green gate: governed done with
+    retries==0 and >=1 rollback avoided while ungoverned retries>=1 (or
+    dies), plus the overhead gate."""
+    import shutil
+    import tempfile
+
+    import numpy as np
+
+    from rustpde_mpi_tpu import DivergenceError, Navier2D, ResilientRunner, config
+    from rustpde_mpi_tpu.config import StabilityConfig
+
+    config.enable_compilation_cache()
+
+    def build(stab=None):
+        model = Navier2D(nx, ny, ra, 1.0, dt, 1.0, "rbc", periodic=False)
+        model.set_velocity(0.1, 2.0, 2.0)
+        model.set_temperature(0.1, 2.0, 2.0)
+        model.write_intervall = 1e9
+        if stab is not None:
+            model.set_stability(stab)
+        return model
+
+    # sentinel overhead via INTERLEAVED slope timing: plain and sentinel
+    # windows alternate rep by rep, so slow host weather (this box is a
+    # shared 2-core container with ±10% drift over minutes — far above the
+    # 5% gate) hits both chains alike; min-of-reps slopes estimate the true
+    # per-step cost of each chain.  benchmark_steps times one model per
+    # call, which bakes minutes of drift into the comparison.
+    import jax as _jax
+
+    m_plain, m_sent = build(), build(StabilityConfig())
+    L = max(16, int(steps))
+    for m in (m_plain, m_sent):  # compile + warm both window lengths
+        m.update_n(L)
+        m.update_n(4 * L)
+        _jax.block_until_ready(m.state)
+    slopes = {"plain": [], "sent": []}
+    for _ in range(5):
+        for key, m in (("plain", m_plain), ("sent", m_sent)):
+            t0 = time.perf_counter()
+            m.update_n(L)
+            _jax.block_until_ready(m.state)
+            t_l = time.perf_counter() - t0
+            t0 = time.perf_counter()
+            m.update_n(4 * L)
+            _jax.block_until_ready(m.state)
+            t_4l = time.perf_counter() - t0
+            slopes[key].append((t_4l - t_l) / (3 * L))
+    ms_plain = min(slopes["plain"]) * 1e3
+    ms_sent = min(slopes["sent"]) * 1e3
+    overhead = ms_sent / ms_plain - 1.0
+    r_plain = {"steps_per_sec": 1e3 / ms_plain}
+    r_sent = {"steps_per_sec": 1e3 / ms_sent}
+
+    # probe the CFL the flow will have AT the spike step (the early flow is
+    # far calmer than the developed one the overhead window ends in), then
+    # size the spike to ~6x the ceiling: violently nonlinear, so an
+    # ungoverned run NaNs within the remaining horizon, while a governed one
+    # descends ~4 rungs (bigger spikes make the post-spike transient grow so
+    # hard the governed leg chases it far down the ladder — slow on CPU)
+    spike_steps = max(32, min(steps, 64))
+    spike_at = max(4, spike_steps // 4)
+    max_time = spike_steps * dt
+    probe = build(StabilityConfig())
+    probe.update_n(spike_at)
+    cfl_base = probe.last_chunk_status.cfl_max
+    spike_factor = 6.0 / max(cfl_base, 1e-9)
+
+    run_dir = tempfile.mkdtemp(prefix="bench_governor_")
+    try:
+        governed = ResilientRunner(
+            build(),
+            max_time,
+            None,
+            run_dir=run_dir,
+            checkpoint_every_s=None,
+            max_retries=2,
+            fault=f"spike@{spike_at}",
+            spike_factor=spike_factor,
+            stability=StabilityConfig(),
+        )
+        t0 = time.perf_counter()
+        g_summary = governed.run()
+        governed_s = time.perf_counter() - t0
+    finally:
+        shutil.rmtree(run_dir, ignore_errors=True)
+
+    run_dir = tempfile.mkdtemp(prefix="bench_governor_ungov_")
+    ungoverned_retries = None
+    ungoverned_outcome = "diverged"
+    try:
+        ungoverned = ResilientRunner(
+            build(),
+            max_time,
+            None,
+            run_dir=run_dir,
+            checkpoint_every_s=None,
+            max_retries=3,
+            fault=f"spike@{spike_at}",
+            spike_factor=spike_factor,
+        )
+        try:
+            u_summary = ungoverned.run()
+            ungoverned_retries = u_summary["retries"]
+            ungoverned_outcome = u_summary["outcome"]
+        except DivergenceError:
+            ungoverned_retries = ungoverned.attempt
+    finally:
+        shutil.rmtree(run_dir, ignore_errors=True)
+
+    health = g_summary["health"]
+    recovered = bool(
+        g_summary["outcome"] == "done"
+        and g_summary["retries"] == 0  # ZERO reactive checkpoint rollbacks
+        and health["pre_divergence_catches"] >= 1
+        and health["rollbacks_avoided"] >= 1
+        and g_summary["nu"] is not None
+        and np.isfinite(g_summary["nu"])
+    )
+    ungoverned_suffered = bool(
+        ungoverned_outcome == "diverged" or (ungoverned_retries or 0) >= 1
+    )
+    overhead_ok = bool(overhead < 0.05)
+    return {
+        "steps_per_sec": r_sent["steps_per_sec"],
+        "plain_steps_per_sec": r_plain["steps_per_sec"],
+        "sentinel_overhead_x": 1.0 + overhead,
+        "sentinel_overhead_ok": overhead_ok,
+        "cfl_base": cfl_base,
+        "spike_factor": spike_factor,
+        "governed_retries": g_summary["retries"],
+        "governed_dt_final": g_summary["dt"],
+        "governed_wall_s": round(governed_s, 2),
+        "rollbacks_avoided": health["rollbacks_avoided"],
+        "pre_divergence_catches": health["pre_divergence_catches"],
+        "dt_trajectory": health["dt_trajectory"],
+        "dt_adjusts": health["dt_adjusts"],
+        "cfl_max_seen": health["cfl_max"],
+        "ungoverned_outcome": ungoverned_outcome,
+        "ungoverned_retries": ungoverned_retries,
+        "nu": g_summary["nu"],
+        "steps": spike_steps,
+        "finite": bool(recovered and ungoverned_suffered and overhead_ok),
     }
 
 
@@ -605,6 +768,10 @@ def main() -> int:
                 # stepping work) plus a recompile, so the window is capped
                 # regardless of RUSTPDE_BENCH_STEPS
                 r = bench_resilience(129, 129, 1e7, 2e-3, max(32, min(steps, 128)))
+            elif name == "governor129":
+                # overhead leg slope-times two chains; the spike legs rerun
+                # a capped horizon (governed: at the descended-ladder dt)
+                r = bench_governor(129, 129, 1e7, 2e-3, max(32, min(steps, 64)))
             elif name in ("rbc129_f64", "rbc1025_f64", "rbc2049_f64", "poisson1025_f64"):
                 env = dict(os.environ, RUSTPDE_X64="1")
                 import subprocess

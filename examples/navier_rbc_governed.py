@@ -1,0 +1,136 @@
+"""Rayleigh–Bénard convection under the proactive stability governor.
+
+The governed long-run driver (utils/governor.py + utils/resilience.py): the
+scanned step chunks carry on-device sentinels — per-step CFL number,
+volume-averaged kinetic energy and the pre-projection |div| residual — and a
+host-side governor drives dt toward a target Courant number on a geometric,
+rung-cached dt ladder.  An incipient blow-up trips the hard CFL ceiling
+*before* NaNs appear: the chunk is rolled back in memory (no checkpoint IO)
+and dt descends the ladder; after a healthy stretch the governor climbs back
+up.  The reactive checkpoint-rollback machinery of
+examples/navier_rbc_resilient.py stays underneath as the last resort.
+
+Watch the whole loop on a deterministic incipient blow-up (a finite
+velocity spike, caught pre-NaN):
+
+    python examples/navier_rbc_governed.py --quick --fault spike@40
+    RUSTPDE_FAULT=spike@60 python examples/navier_rbc_governed.py --quick
+
+The run prints the journal's cfl/dt_adjust trail and ends with the RunHealth
+summary (dt trajectory, sentinel extrema, checkpoint rollbacks avoided).
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from rustpde_mpi_tpu import (
+    DispatchHang,
+    DivergenceError,
+    Navier2D,
+    ResilientRunner,
+)
+from rustpde_mpi_tpu.config import StabilityConfig
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true", help="small fast config")
+    ap.add_argument("--nx", type=int, default=None)
+    ap.add_argument("--ny", type=int, default=None)
+    ap.add_argument("--ra", type=float, default=None)
+    ap.add_argument("--dt", type=float, default=None)
+    ap.add_argument("--max-time", type=float, default=None)
+    ap.add_argument("--run-dir", default="data/governed")
+    ap.add_argument(
+        "--target-cfl", type=float, default=0.5,
+        help="Courant number the dt controller drives toward",
+    )
+    ap.add_argument(
+        "--max-cfl", type=float, default=1.0,
+        help="hard on-device ceiling: chunks early-exit (pre-divergence) above it",
+    )
+    ap.add_argument(
+        "--ladder-ratio", type=float, default=2.0,
+        help="geometric dt-ladder spacing (solver factorizations cached per rung)",
+    )
+    ap.add_argument(
+        "--grow-after", type=int, default=4,
+        help="healthy chunks at a rung before climbing back up the ladder",
+    )
+    ap.add_argument(
+        "--dt-max", type=float, default=None,
+        help="ladder ceiling (default: the starting dt)",
+    )
+    ap.add_argument("--dt-min", type=float, default=None, help="ladder floor")
+    ap.add_argument(
+        "--ckpt-every-s", type=float, default=300.0,
+        help="wall-clock checkpoint cadence (the reactive safety net below)",
+    )
+    ap.add_argument("--retries", type=int, default=3, help="reactive divergence retries")
+    ap.add_argument(
+        "--fault", default=None,
+        help="inject a deterministic fault: spike@<step> (pre-divergence "
+        "catch) | nan@<step> | kill@<step> | slow@<step> (also via "
+        "RUSTPDE_FAULT)",
+    )
+    ap.add_argument(
+        "--spike-factor", type=float, default=None,
+        help="velocity scale of the spike fault (default 50, or "
+        "RUSTPDE_SPIKE_FACTOR)",
+    )
+    args = ap.parse_args()
+
+    if args.quick:
+        nx, ny, ra, dt, max_time, save = 33, 33, 1e5, 0.01, 1.0, 0.25
+    else:
+        nx, ny, ra, dt, max_time, save = 129, 129, 1e7, 2e-3, 10.0, 1.0
+    nx = args.nx or nx
+    ny = args.ny or ny
+    ra = args.ra or ra
+    dt = args.dt or dt
+    max_time = args.max_time or max_time
+
+    model = Navier2D.new_confined(nx, ny, ra, 1.0, dt, 1.0, "rbc")
+    runner = ResilientRunner(
+        model,
+        max_time=max_time,
+        save_intervall=save,
+        run_dir=args.run_dir,
+        checkpoint_every_s=args.ckpt_every_s,
+        max_retries=args.retries,
+        fault=args.fault,
+        spike_factor=args.spike_factor,
+        stability=StabilityConfig(
+            target_cfl=args.target_cfl,
+            max_cfl=args.max_cfl,
+            ladder_ratio=args.ladder_ratio,
+            grow_after=args.grow_after,
+            dt_max=args.dt_max,
+            dt_min=args.dt_min,
+        ),
+    )
+    try:
+        summary = runner.run()
+    except DivergenceError as exc:
+        print(f"unrecoverable divergence: {exc}")
+        return 2
+    except DispatchHang as exc:
+        print(f"dispatch hang: {exc}")
+        return 3
+
+    # replay the governor's trail from the journal
+    with open(runner.journal_path, encoding="utf-8") as fh:
+        for line in fh:
+            event = json.loads(line)
+            if event["event"] in ("pre_divergence", "dt_adjust", "run_health"):
+                print(json.dumps(event))
+    print(json.dumps(summary))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

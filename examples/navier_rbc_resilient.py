@@ -52,8 +52,10 @@ def main() -> int:
     )
     ap.add_argument(
         "--fault", default=None,
-        help="inject a deterministic fault: nan@<step> | kill@<step> | "
-        "slow@<step> (also via RUSTPDE_FAULT)",
+        help="inject a deterministic fault: nan@<step> | spike@<step> | "
+        "kill@<step> | slow@<step> (also via RUSTPDE_FAULT; spike is the "
+        "finite incipient blow-up the governed driver "
+        "examples/navier_rbc_governed.py catches pre-NaN)",
     )
     ap.add_argument(
         "--fresh", action="store_true",

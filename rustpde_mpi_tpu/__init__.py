@@ -34,6 +34,12 @@ from .models.opt_routines import steepest_descent_energy_constrained  # noqa: F4
 from .models.statistics import Statistics  # noqa: F401
 from .models.steady_adjoint import Navier2DAdjoint  # noqa: F401
 from .models.swift_hohenberg import SwiftHohenberg1D, SwiftHohenberg2D  # noqa: F401
+from .utils.governor import (  # noqa: F401
+    ChunkStatus,
+    DtLadder,
+    RunHealth,
+    StabilityGovernor,
+)
 from .utils.integrate import Integrate, integrate  # noqa: F401
 from .utils.resilience import (  # noqa: F401
     DispatchHang,

@@ -120,6 +120,43 @@ from dataclasses import dataclass, field
 
 
 @dataclass
+class StabilityConfig:
+    """Knobs for the proactive stability governor
+    (:class:`~rustpde_mpi_tpu.utils.governor.StabilityGovernor` + the
+    on-device sentinels compiled into the scanned step when a model's
+    ``set_stability`` is called).
+
+    * ``target_cfl`` — the Courant number the dt controller drives toward,
+    * ``max_cfl`` — the hard on-device ceiling: a chunk whose per-step CFL
+      exceeds it early-exits the scan with a ``pre_divergence`` status
+      *before* NaNs propagate (recovered by a cheap in-memory rollback of
+      just that chunk),
+    * ``ladder_ratio`` — geometric spacing of the dt ladder the controller
+      quantizes to (the dt-baked solver factorizations are cached per rung,
+      so the re-jit/refactorization count over a long run is bounded by the
+      ladder size),
+    * ``dt_min``/``dt_max`` — ladder bounds (None: ``dt_max`` anchors at the
+      run's initial dt, ``dt_min`` at ``dt_max * ladder_ratio**-10``),
+    * ``grow_after`` — healthy chunks at a rung before the governor climbs
+      back up the ladder (the regrowth the reactive backoff lacks),
+    * ``shrink_cfl`` — proactive shrink threshold (None:
+      ``0.85 * max_cfl``): a chunk whose max CFL exceeds it steps the ladder
+      down *without* any rollback,
+    * ``member_pin_patience`` — consecutive pre-divergence catches pinned on
+      the same ensemble member before that member is declared dead and
+      handed to the ``respawn_dead`` machinery."""
+
+    target_cfl: float = 0.5
+    max_cfl: float = 1.0
+    ladder_ratio: float = 2.0
+    dt_min: float | None = None
+    dt_max: float | None = None
+    grow_after: int = 4
+    shrink_cfl: float | None = None
+    member_pin_patience: int = 3
+
+
+@dataclass
 class ResilienceConfig:
     """Knobs for :class:`~rustpde_mpi_tpu.utils.resilience.ResilientRunner`
     (field names match the runner's keyword arguments; build one via
@@ -128,8 +165,13 @@ class ResilienceConfig:
     ``checkpoint_every_s``/``checkpoint_every_t`` are the wall-clock and
     sim-time checkpoint cadences (either may be None); ``keep`` is the
     rolling retention window; ``dt_backoff`` is the divergence-retry step
-    shrink factor; ``dispatch_timeout_s`` arms the device-dispatch hang
-    watchdog (None = RUSTPDE_DISPATCH_TIMEOUT_S env, unset = off)."""
+    shrink factor with ``dt_min`` as its hard floor (so compounding backoff
+    cannot drive dt toward denormals); ``respawn_seed`` carries the PRNG
+    seed for ``respawn_dead`` donor perturbations (recovery runs are
+    reproducible when set); ``dispatch_timeout_s`` arms the device-dispatch
+    hang watchdog (None = RUSTPDE_DISPATCH_TIMEOUT_S env, unset = off);
+    ``stability`` enables the proactive governor
+    (:class:`StabilityConfig`)."""
 
     run_dir: str = "data/resilient"
     checkpoint_every_s: float | None = 300.0
@@ -137,10 +179,13 @@ class ResilienceConfig:
     keep: int = 3
     max_retries: int = 3
     dt_backoff: float = 0.5
+    dt_min: float = 0.0
     respawn_members: bool = False
     respawn_amp: float = 1e-3
+    respawn_seed: int | None = None
     dispatch_timeout_s: float | None = None
     resume: bool = True
+    stability: StabilityConfig | None = None
 
 
 @dataclass
@@ -170,6 +215,9 @@ class NavierConfig:
     # resilience-harness knobs (None = run without the harness; see
     # ResilienceConfig / utils/resilience.ResilientRunner)
     resilience: ResilienceConfig | None = None
+    # stability-sentinel knobs (None = plain stepping; see StabilityConfig /
+    # utils/governor.py) — from_config calls model.set_stability(stability)
+    stability: StabilityConfig | None = None
 
     def ctor_args(self) -> tuple:
         return (self.nx, self.ny, self.ra, self.pr, self.dt, self.aspect, self.bc)

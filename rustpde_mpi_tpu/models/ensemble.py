@@ -78,6 +78,16 @@ class NavierEnsemble(Integrate):
         # per-member diagnostics history: each append is a length-K list
         self.diagnostics: dict[str, list] = {}
         self._obs_cache: tuple | None = None
+        # stability sentinels (mirrors Navier2D; armed when the template
+        # model's set_stability was called) + per-rung artifact cache
+        self.last_chunk_status = None
+        self._pre_div_latch = False
+        self._dt_cache: dict[float, dict] = {}
+        self.recompile_count = 0
+        # config-carried PRNG stream for respawn_dead donor perturbations
+        # (reproducible recovery runs); None falls back to per-call seeds
+        self.respawn_seed: int | None = None
+        self._respawn_rng = None
         self._compile_entry_points()
         with model._scope():
             self.state = stacked
@@ -119,8 +129,12 @@ class NavierEnsemble(Integrate):
         model = Navier2D.from_config(cfg, mesh=mesh)
         k = max(1, cfg.ensemble)
         if not cfg.init_random_amp:
-            return cls.replicate(model, k)
-        return cls.from_seeds(model, range(k), amp=cfg.init_random_amp)
+            ens = cls.replicate(model, k)
+        else:
+            ens = cls.from_seeds(model, range(k), amp=cfg.init_random_amp)
+        if cfg.resilience is not None:
+            ens.respawn_seed = cfg.resilience.respawn_seed
+        return ens
 
     # -- member access -------------------------------------------------------
 
@@ -171,6 +185,8 @@ class NavierEnsemble(Integrate):
         model = self.model
         step_cc = model._step_cc
         obs_cc = model._obs_cc
+        self.recompile_count += 1
+        self._step_n_sent = None
 
         if model._gspmd_split_sep_fallback():
             # same poisoned layout the single-run guard reroutes (fused
@@ -263,6 +279,66 @@ class NavierEnsemble(Integrate):
         obs_jit = jax.jit(jax.vmap(obs_cc, in_axes=(None, 0)))
         self._obs_fn = lambda st: obs_jit(model._obs_consts, st)
 
+        if model._sent_cc is not None:
+            self._compile_sentinel_entry_points()
+
+    def _compile_sentinel_entry_points(self) -> None:
+        """Vmapped sentinel chunk (stability governor, utils/governor.py):
+        the per-member carry holds finite AND CFL-ok masks plus running
+        per-member sentinel reductions.  A member whose per-step CFL exceeds
+        the ceiling freezes at its last under-ceiling state (it does NOT
+        take the tripping step) while staying finite — distinct from death —
+        and the batch-wide scalar early-exit fires once no member is both
+        finite and under the ceiling.  Per-member CFL reduces to the batch
+        max host-side (members share the baked dt)."""
+        model = self.model
+        sent_cc = model._sent_cc
+        ceiling = float(model._stability.max_cfl)
+
+        def ens_step_n_sent(consts, carry, n: int):
+            vstep = jax.vmap(lambda s: sent_cc(consts, s))
+
+            def advance(carry):
+                st, fin, cok, dn, cflm, gm, dvm, kep = carry
+                st2, (cfl, ke, dv) = vstep(st)
+                active = fin & cok
+                fin2 = jnp.where(active, self._finite_mask(st2), fin)
+                cok2 = jnp.where(active, jnp.logical_not(cfl > ceiling), cok)
+                keep = active & fin2 & cok2
+
+                def freeze(new, old):
+                    sel = jnp.reshape(keep, keep.shape + (1,) * (new.ndim - 1))
+                    return jnp.where(sel, new, old)
+
+                def upd(old, new):
+                    return jnp.where(active, jnp.maximum(old, new), old)
+
+                growth = jnp.where(kep > 0.0, ke / kep, 1.0)
+                return (
+                    jax.tree.map(freeze, st2, st),
+                    fin2,
+                    cok2,
+                    dn + keep.astype(jnp.int32),
+                    upd(cflm, cfl),
+                    upd(gm, growth),
+                    upd(dvm, dv),
+                    jnp.where(active, ke, kep),
+                )
+
+            def body(carry, _):
+                carry2 = jax.lax.cond(
+                    jnp.any(carry[1] & carry[2]), advance, lambda c: c, carry
+                )
+                return carry2, None
+
+            final, _ = jax.lax.scan(body, carry, None, length=n)
+            return final
+
+        sent_jit = jax.jit(
+            ens_step_n_sent, static_argnames=("n",), donate_argnums=(1,)
+        )
+        self._step_n_sent = lambda c, n: sent_jit(model._sent_consts, c, n=n)
+
     def _make_step(self):
         """vmapped single-member step — profiling.step_flops introspects this
         (the batched dot_generals in its jaxpr carry the K factor, so the
@@ -274,16 +350,26 @@ class NavierEnsemble(Integrate):
     def update(self) -> None:
         self.update_n(1)
 
-    def update_n(self, n: int) -> None:
+    def update_n(self, n: int):
         """Advance every alive member n steps in scanned power-of-two chunks.
 
         The chunked dispatch donates its carry, so it must never receive the
         user-visible buffers — one copy of (state, mask, counters) per call
         keeps retained references valid while every inter-bucket hand-off
         inside the chain is donated.  ``self.time`` counts scheduled steps;
-        ``self.steps_done`` records how far each member actually advanced."""
+        ``self.steps_done`` records how far each member actually advanced.
+
+        With stability sentinels armed (template model's ``set_stability``)
+        the chunk returns a :class:`~rustpde_mpi_tpu.utils.governor.ChunkStatus`
+        carrying per-member chunk-max CFL (``cfl_members``) and ceiling-trip
+        masks (``pinned``); ANY alive member tripping the hard CFL ceiling
+        rolls the whole chunk back in memory (members share the baked dt, so
+        the dt response is batch-wide) and latches ``exit()`` until a
+        governor acknowledges."""
         from ..utils.jit import run_scanned
 
+        if self._step_n_sent is not None:
+            return self._update_n_sentinel(n)
         with self.model._scope():
             carry = jax.tree.map(
                 jnp.copy, (self.state, self.mask, self.steps_done)
@@ -294,6 +380,90 @@ class NavierEnsemble(Integrate):
             self.state, self.mask, self.steps_done = carry
         self.time += n * self.dt
         self._obs_cache = None
+        return None
+
+    def _update_n_sentinel(self, n: int):
+        """Sentinel-armed batched chunk (see :meth:`update_n`)."""
+        from .. import config
+        from ..utils.governor import ChunkStatus
+        from ..utils.jit import run_scanned
+
+        self._pre_div_latch = False
+        rdt = config.real_dtype()
+        done_before = np.asarray(self.steps_done).copy()
+        with self.model._scope():
+            # distinct buffers per slot: the dispatch donates the whole
+            # carry, and donation rejects the same buffer appearing twice
+            carry = (
+                jax.tree.map(jnp.copy, self.state),
+                jnp.copy(self.mask),
+                jnp.ones((self.k,), bool),
+                jnp.copy(self.steps_done),
+                jnp.zeros((self.k,), rdt),  # per-member cfl max
+                jnp.zeros((self.k,), rdt),  # per-member ke growth max
+                jnp.zeros((self.k,), rdt),  # per-member |div| max
+                jnp.zeros((self.k,), rdt),  # per-member previous-step ke
+            )
+            carry = run_scanned(lambda c, k: self._step_n_sent(c, k), carry, n)
+        st, fin, cok, dn, cflm, gm, dvm, kep = carry
+        fin_h = np.asarray(fin)
+        pinned = fin_h & ~np.asarray(cok)
+        pre_div = bool(pinned.any())
+        if pre_div:
+            # in-memory rollback of the whole chunk: state/mask/counters are
+            # the un-donated chunk-start snapshots — keep them
+            self._pre_div_latch = True
+        else:
+            self.state, self.mask, self.steps_done = st, fin, dn
+            self.time += n * self.dt
+        cflm_h = np.asarray(cflm)
+        delta = np.asarray(dn) - done_before
+        status = ChunkStatus(
+            requested=int(n),
+            steps_done=int(delta.max(initial=0)),
+            finite=bool(fin_h.any()),
+            cfl_ok=not pre_div,
+            pre_divergence=pre_div,
+            cfl_max=float(cflm_h.max(initial=0.0)),  # the batch-max reduction
+            ke=float(np.asarray(kep).max(initial=0.0)),
+            ke_growth_max=float(np.asarray(gm).max(initial=0.0)),
+            div_max=float(np.asarray(dvm).max(initial=0.0)),
+            dt=self.dt,
+            cfl_members=tuple(float(c) for c in cflm_h),
+            pinned=tuple(bool(p) for p in pinned),
+        )
+        self.last_chunk_status = status
+        self._obs_cache = None
+        return status
+
+    @property
+    def _stability(self):
+        """The sentinel config lives on the shared template model."""
+        return self.model._stability
+
+    def set_stability(self, cfg) -> None:
+        """Arm/disarm the stability sentinels on the shared template model
+        and re-vmap the ensemble entry points on top."""
+        self.model.set_stability(cfg)
+        self._dt_cache.clear()
+        self._compile_entry_points()
+        self.last_chunk_status = None
+        self._pre_div_latch = False
+
+    def clear_pre_divergence(self) -> None:
+        """Acknowledge a ``pre_divergence`` catch (governor handled it)."""
+        self._pre_div_latch = False
+
+    def mark_dead(self, members) -> None:
+        """Declare members dead (persistently CFL-pinned, governor decision):
+        they freeze like diverged members and become ``respawn_dead``
+        candidates."""
+        with self.model._scope():
+            mask = self.mask
+            for i in members:
+                mask = mask.at[int(i)].set(False)
+            self.mask = mask
+        self._obs_cache = None
 
     def get_time(self) -> float:
         return self.time
@@ -301,20 +471,36 @@ class NavierEnsemble(Integrate):
     def get_dt(self) -> float:
         return self.dt
 
+    # swapped per dt change, cached per rung like Navier2D._DT_ARTIFACTS
+    _DT_ARTIFACTS = ("_step_n", "_obs_fn", "_step_n_sent")
+
     def set_dt(self, dt: float) -> None:
-        """Propagate a dt change (divergence-retry backoff) through the
-        shared template model — which rebuilds its dt-baked solvers and
-        re-traces ``_step_cc`` — then re-vmap the ensemble entry points on
-        top of the new jaxpr.  Member states are untouched."""
+        """Propagate a dt change (the governor's ladder / divergence-retry
+        backoff) through the shared template model — which rebuilds its
+        dt-baked solvers and re-traces ``_step_cc``, both cached per dt rung
+        — then re-vmap the ensemble entry points on top of the new jaxpr
+        (also rung-cached: a revisited rung restores the retained closures,
+        so the jit executable cache hits).  Member states are untouched."""
+        dt = float(dt)
+        if dt == self.dt:
+            return
+        self._dt_cache[self.dt] = {
+            k: getattr(self, k, None) for k in self._DT_ARTIFACTS
+        }
         self.model.set_dt(dt)
         self.dt = self.model.dt
-        self._compile_entry_points()
+        cached = self._dt_cache.get(dt)
+        if cached is not None:
+            for key, value in cached.items():
+                setattr(self, key, value)
+        else:
+            self._compile_entry_points()
         self._obs_cache = None
 
     def reset_time(self) -> None:
         self.time = 0.0
 
-    def respawn_dead(self, amp: float = 1e-3, seed: int | None = None) -> int:
+    def respawn_dead(self, amp: float = 1e-3, seed=None) -> int:
         """Re-seed every dead member from a perturbed healthy donor instead
         of leaving it frozen forever (utils/resilience.py calls this at
         rollback when ``respawn_members`` is on).
@@ -326,11 +512,22 @@ class NavierEnsemble(Integrate):
         members; surviving members' states are NOT touched (their buffers
         are updated per-index, ``set_member``).  Returns the number of
         members respawned (0 when all alive or none alive — with no healthy
-        donor there is nothing to copy from)."""
+        donor there is nothing to copy from).
+
+        ``seed`` may be an int or a sequence of ints (a SeedSequence
+        entropy key, e.g. ``(campaign_seed, step, attempt)``); when ``None``
+        and a config-carried ``respawn_seed`` is set
+        (``ResilienceConfig.respawn_seed``), draws come from that persistent
+        stream — so two identical recovery runs respawn identically."""
         alive = self.alive()
         if alive.all() or not alive.any():
             return 0
-        rng = np.random.default_rng(seed)
+        if seed is None and self.respawn_seed is not None:
+            if self._respawn_rng is None:
+                self._respawn_rng = np.random.default_rng(self.respawn_seed)
+            rng = self._respawn_rng
+        else:
+            rng = np.random.default_rng(seed)
         donors = np.flatnonzero(alive)
         respawned = 0
         for i in np.flatnonzero(~alive):
@@ -360,7 +557,11 @@ class NavierEnsemble(Integrate):
     def exit(self) -> bool:
         """Graceful degradation: the break criterion fires only when EVERY
         member has diverged — one NaN member freezes (update_n) and is
-        reported per member, it does not kill the batch."""
+        reported per member, it does not kill the batch.  A latched
+        ``pre_divergence`` catch (stability sentinels) also reads as a break
+        until a governor clears it (see ``Navier2D.exit``)."""
+        if self._pre_div_latch:
+            return True
         return not bool(np.any(self.alive()))
 
     # -- observables / IO ----------------------------------------------------

@@ -92,6 +92,16 @@ class Navier2D(Integrate):
         self.statistics = None
         self._obs_cache: tuple | None = None
         self._solid = None  # (penalization factors) set via set_solid()
+        # stability sentinels (utils/governor.py): None = plain stepping;
+        # set_stability compiles the sentinel step variant into update_n
+        self._stability = None
+        self.last_chunk_status = None
+        self._pre_div_latch = False
+        # per-rung cache of dt-baked artifacts (solvers + compiled entry
+        # points), so a governor cycling a bounded dt ladder re-jits each
+        # rung at most once; recompile_count tracks actual rebuilds
+        self._dt_cache: dict[float, dict] = {}
+        self.recompile_count = 0
         # diagnostics history appended by the IO callback — the map the
         # reference allocates but never writes (navier.rs:81)
         self.diagnostics: dict[str, list[float]] = {}
@@ -123,6 +133,17 @@ class Navier2D(Integrate):
         rdt = config.real_dtype()
         self._w0 = jnp.asarray(w0, dtype=rdt)
         self._w1 = jnp.asarray(w1, dtype=rdt)
+        # per-point inverse grid spacing (physical, scaled) for the pointwise
+        # advective CFL sentinel dt*max(|ux|/dx + |uy|/dy): cell widths from
+        # the same midpoint rule the averages use — near a Chebyshev wall the
+        # spacing is O(1/N^2) but the no-slip velocity vanishes linearly, so
+        # the pointwise ratio self-limits to the local shear rate
+        from ..field import grid_deltas
+
+        dx0 = grid_deltas(xs, self.field_space.base_x.is_periodic) * self.scale[0]
+        dy0 = grid_deltas(ys, False) * self.scale[1]
+        self._inv_dx = jnp.asarray(1.0 / dx0, dtype=rdt)
+        self._inv_dy = jnp.asarray(1.0 / dy0, dtype=rdt)
 
         # implicit solvers (/root/reference/src/navier_stokes/navier.rs:263-275)
         sx2, sy2 = self.scale[0] ** 2, self.scale[1] ** 2
@@ -209,6 +230,10 @@ class Navier2D(Integrate):
         )
         from ..utils.jit import hoist_constants
 
+        self.recompile_count += 1
+        self._sent_cc = None
+        self._sent_consts = None
+        self._step_n_sent = None
         with self._scope():
             step_cc, step_consts = hoist_constants(self._make_step(), example)
             obs_cc, obs_consts = hoist_constants(self._make_observables(), example)
@@ -295,6 +320,62 @@ class Navier2D(Integrate):
         obs_jit = jax.jit(obs_cc)
         self._obs_fn = lambda s: obs_jit(self._obs_consts, s)
 
+        if self._stability is not None:
+            self._compile_sentinel_entry_points(example)
+
+    def _compile_sentinel_entry_points(self, example) -> None:
+        """Sentinel variant of the scanned chunk (set_stability): the carry
+        additionally holds a CFL-ok flag and running sentinel reductions, and
+        the early-exit fires on EITHER a non-finite state (the NaN path, as
+        before) or a per-step CFL above ``max_cfl`` — the *pre-divergence*
+        catch, taken while the state is still finite so the chunk can be
+        recovered by an in-memory rollback instead of a checkpoint restore.
+        One small scalar fetch per chunk; the buckets themselves stay
+        asynchronous and donate their carry like the plain path."""
+        from ..utils.jit import hoist_constants
+
+        with self._scope():
+            sent_cc, sent_consts = hoist_constants(
+                self._make_step(with_sentinels=True), example
+            )
+        self._sent_cc = sent_cc
+        self._sent_consts = sent_consts
+        ceiling = float(self._stability.max_cfl)
+
+        def step_n_sent(consts, carry, n: int):
+            def advance(carry):
+                st, fin, cok, done, cflm, gm, dvm, kep = carry
+                st2, (cfl, ke, dv) = sent_cc(consts, st)
+                fin2 = jnp.isfinite(jnp.sum(st2.temp))
+                # NaN cfl must read as the NaN path, not a ceiling trip:
+                # NaN > ceiling is False, so ~(cfl > ceiling) stays True
+                cok2 = jnp.logical_not(cfl > ceiling)
+                growth = jnp.where(kep > 0.0, ke / kep, 1.0)
+                return (
+                    st2,
+                    fin2,
+                    cok2,
+                    done + 1,
+                    jnp.maximum(cflm, cfl),
+                    jnp.maximum(gm, growth),
+                    jnp.maximum(dvm, dv),
+                    ke,
+                )
+
+            def body(carry, _):
+                carry2 = jax.lax.cond(
+                    carry[1] & carry[2], advance, lambda c: c, carry
+                )
+                return carry2, None
+
+            final, _ = jax.lax.scan(body, carry, None, length=n)
+            return final
+
+        sent_jit = jax.jit(
+            step_n_sent, static_argnames=("n",), donate_argnums=(1,)
+        )
+        self._step_n_sent = lambda c, n: sent_jit(self._sent_consts, c, n=n)
+
     # -- sharding helpers ----------------------------------------------------
 
     def _scope(self):
@@ -339,6 +420,8 @@ class Navier2D(Integrate):
             model.init_random(cfg.init_random_amp)
         model.write_intervall = cfg.write_intervall
         model.params.update(cfg.params)
+        if getattr(cfg, "stability", None) is not None:
+            model.set_stability(cfg.stability)
         return model
 
     def _build_bc_fields(self, xs: np.ndarray, ys: np.ndarray) -> None:
@@ -385,6 +468,9 @@ class Navier2D(Integrate):
         which is unconditionally stable for any eta.  Pass ``mask=None`` to
         remove the obstacle."""
         rdt = config.real_dtype()
+        # cached per-dt artifacts embed the penalization factors of the OLD
+        # obstacle — changing the obstacle invalidates every rung
+        self._dt_cache.clear()
         if mask is None:
             self._solid = None
             self._compile_entry_points()
@@ -462,10 +548,20 @@ class Navier2D(Integrate):
 
     # -- the time step -------------------------------------------------------
 
-    def _make_step(self):
+    def _make_step(self, with_sentinels: bool = False):
+        """The jitted step.  ``with_sentinels=True`` returns
+        ``(state, (cfl, ke, div_norm))`` instead of just the state: pointwise
+        advective CFL ``dt*max(|ux|/dx + |uy|/dy)`` and volume-averaged
+        kinetic energy of the *consumed* state, plus the pre-projection
+        divergence residual — all cheap reductions over arrays the step
+        already materializes (the physical convection velocities and the
+        projection RHS), so the state math is untouched and the overhead is
+        a handful of elementwise ops per step."""
         dt = self.dt
         scale = self.scale
         nu = self.params["nu"]
+        inv_dx, inv_dy = self._inv_dx, self._inv_dy
+        w0s, w1s = self._w0, self._w1
         sp_t, sp_u, sp_v = self.temp_space, self.velx_space, self.vely_space
         sp_p, sp_q, sp_f = self.pres_space, self.pseu_space, self.field_space
         mask = self._dealias
@@ -552,6 +648,14 @@ class Navier2D(Integrate):
             ux = sp_u.backward_fast(velx)
             uy = sp_v.backward_fast(vely)
 
+            if with_sentinels:
+                # sentinels of the consumed state, from the velocities the
+                # convection terms need anyway (no extra transforms)
+                cfl = dt * jnp.max(
+                    jnp.abs(ux) * inv_dx[:, None] + jnp.abs(uy) * inv_dy[None, :]
+                )
+                ke = 0.5 * jnp.sum((ux**2 + uy**2) * w0s[:, None] * w1s[None, :])
+
             # horizontal momentum (navier_eq.rs:176-187)
             rhs = sp_u.to_ortho(velx)
             rhs = rhs - dt * sp_p.gradient(pres, (1, 0), scale)
@@ -603,9 +707,14 @@ class Navier2D(Integrate):
             # x-pencil layout, and XLA's sharding propagation is free to emit
             # replicated outputs otherwise — which silently serializes a
             # multi-chip run
-            return NavierState(
+            state_n = NavierState(
                 pin(temp_n), pin(velx_n), pin(vely_n), pin(pres_n), pin(pseu_n)
             )
+            if with_sentinels:
+                # |div| of the uncorrected velocities — the residual the
+                # projection removes this step; its blow-up tracks the flow's
+                return state_n, (cfl, ke, norm_l2(div))
+            return state_n
 
         return step
 
@@ -672,16 +781,27 @@ class Navier2D(Integrate):
             self.state = self._step(self.state)
         self.time += self.dt
 
-    def update_n(self, n: int) -> None:
+    def update_n(self, n: int):
         """Advance n steps on the device via scanned power-of-two chunks
         (utils/jit.run_scanned).  Dispatches stay asynchronous (no per-bucket
         host sync — through the relay a sync costs ~110 ms) and donate their
         input state buffers (see _compile_entry_points); on divergence the
         in-scan early exit freezes the state, ``exit()`` reports it at the
         next chunk boundary, and ``self.time`` deliberately counts the
-        scheduled steps (the post-NaN run is over either way)."""
+        scheduled steps (the post-NaN run is over either way).
+
+        With stability sentinels armed (:meth:`set_stability`) the chunk
+        additionally returns a :class:`~rustpde_mpi_tpu.utils.governor.ChunkStatus`
+        (also stored as ``self.last_chunk_status``): a per-step CFL above the
+        hard ceiling early-exits the scan with ``pre_divergence`` while the
+        state is still finite, the chunk is rolled back in memory (state and
+        time untouched — the chunk-start snapshot is exactly the un-donated
+        ``self.state``) and ``exit()`` latches True until a governor
+        acknowledges via :meth:`clear_pre_divergence`."""
         from ..utils.jit import run_scanned
 
+        if self._step_n_sent is not None:
+            return self._update_n_sentinel(n)
         with self._scope():
             # the chunked dispatch donates its input buffers; hand it a copy
             # so a state reference the caller retained stays readable, while
@@ -691,6 +811,80 @@ class Navier2D(Integrate):
                 lambda s, k: self._step_n(s, k)[0], state, n
             )
         self.time += n * self.dt
+        return None
+
+    def _update_n_sentinel(self, n: int):
+        """Sentinel-armed chunk: scan with CFL/KE/|div| reductions riding the
+        carry, one scalar fetch at the end (the only extra host sync)."""
+        from ..utils.governor import ChunkStatus
+        from ..utils.jit import run_scanned
+
+        self._pre_div_latch = False
+        rdt = config.real_dtype()
+        with self._scope():
+            state = jax.tree.map(jnp.copy, self.state)
+            carry = (
+                state,
+                jnp.asarray(True),
+                jnp.asarray(True),
+                jnp.asarray(0, jnp.int32),
+                jnp.asarray(0.0, rdt),  # cfl max
+                jnp.asarray(0.0, rdt),  # ke growth max
+                jnp.asarray(0.0, rdt),  # |div| max
+                jnp.asarray(0.0, rdt),  # previous-step ke
+            )
+            carry = run_scanned(lambda c, k: self._step_n_sent(c, k), carry, n)
+        st, fin, cok, done, cflm, gm, dvm, ke = carry
+        fin, cok = bool(fin), bool(cok)
+        pre_div = fin and not cok
+        if pre_div:
+            # in-memory rollback: the dispatch stepped a donated COPY, so
+            # self.state still holds the chunk-start snapshot — keep it,
+            # leave time untouched, and latch exit() until a governor acts
+            self._pre_div_latch = True
+        else:
+            self.state = st
+            self.time += n * self.dt
+        status = ChunkStatus(
+            requested=int(n),
+            steps_done=int(done),
+            finite=fin,
+            cfl_ok=cok,
+            pre_divergence=pre_div,
+            cfl_max=float(cflm),
+            ke=float(ke),
+            ke_growth_max=float(gm),
+            div_max=float(dvm),
+            dt=self.dt,
+        )
+        self.last_chunk_status = status
+        return status
+
+    def set_stability(self, cfg) -> None:
+        """Arm/disarm (``None``) the on-device stability sentinels
+        (:class:`~rustpde_mpi_tpu.config.StabilityConfig`): compiles the
+        sentinel variant of the scanned chunk into :meth:`update_n`.  Under
+        the GSPMD split-sep fallback the sentinel path is unavailable and
+        stepping stays plain (a one-time warning is emitted)."""
+        self._stability = cfg
+        self._dt_cache.clear()  # cached artifacts lack/stale sentinel entries
+        self._compile_entry_points()
+        if cfg is not None and self._step_n_sent is None:
+            import warnings
+
+            warnings.warn(
+                "stability sentinels are not available on the per-stage "
+                "eager GSPMD fallback path; stepping stays plain",
+                RuntimeWarning,
+                stacklevel=2,
+            )
+        self.last_chunk_status = None
+        self._pre_div_latch = False
+
+    def clear_pre_divergence(self) -> None:
+        """Acknowledge a ``pre_divergence`` catch (the governor changed dt /
+        killed members and wants the chunk retried): unlatch ``exit()``."""
+        self._pre_div_latch = False
 
     def get_time(self) -> float:
         return self.time
@@ -698,22 +892,63 @@ class Navier2D(Integrate):
     def get_dt(self) -> float:
         return self.dt
 
+    # attributes a dt change swaps out, cached per rung so a governor
+    # cycling a bounded dt ladder refactorizes/re-jits each rung ONCE
+    # (solver_pres is dt-independent; tempbc_ortho/_tempbc_dx/_tempbc_dy are
+    # cached alongside because _build_bc_fields rebuilds them together)
+    _DT_ARTIFACTS = (
+        "solver_velx",
+        "solver_vely",
+        "solver_temp",
+        "tempbc_ortho",
+        "_tempbc_dx",
+        "_tempbc_dy",
+        "_tempbc_diff",
+        "_solid",
+        "_step",
+        "_step_n",
+        "_obs_fn",
+        "_step_cc",
+        "_obs_cc",
+        "_step_consts",
+        "_obs_consts",
+        "_sent_cc",
+        "_sent_consts",
+        "_step_n_sent",
+    )
+
+    def _dt_artifacts(self) -> dict:
+        return {k: getattr(self, k, None) for k in self._DT_ARTIFACTS}
+
     def set_dt(self, dt: float) -> None:
-        """Change the time-step size of a live model (the divergence-retry
-        dt backoff, utils/resilience.py).
+        """Change the time-step size of a live model (the governor's dt
+        ladder and the divergence-retry backoff, utils/resilience.py +
+        utils/governor.py).
 
         dt is baked deep into the pipeline — the implicit Helmholtz solvers
         factorize ``dt*nu`` / ``dt*ka``, the BC diffusion source scales with
-        dt, and a solid mask's penalization factors use dt/eta — so this
-        rebuilds solvers + lift-field derivatives and re-traces the jitted
-        entry points.  State and time are untouched: the flow continues from
-        the same fields at the new step size."""
+        dt, and a solid mask's penalization factors use dt/eta — so a FIRST
+        visit to a dt rebuilds solvers + lift-field derivatives and
+        re-traces the jitted entry points.  Every artifact is then cached
+        per dt value, so revisiting a rung (the governor climbing back up
+        its ladder) swaps the cached objects back in — the retained jit
+        closures keep their identity, so XLA's executable cache hits and the
+        total re-jit count over a long governed run is bounded by the ladder
+        size.  State and time are untouched either way: the flow continues
+        from the same fields at the new step size."""
         dt = float(dt)
         if dt <= 0.0:
             raise ValueError(f"dt must be positive, got {dt}")
         if dt == self.dt:
             return
+        self._dt_cache[self.dt] = self._dt_artifacts()
         self.dt = dt
+        cached = self._dt_cache.get(dt)
+        if cached is not None:
+            for key, value in cached.items():
+                setattr(self, key, value)
+            self._obs_cache = None
+            return
         nu, ka = self.params["nu"], self.params["ka"]
         sx2, sy2 = self.scale[0] ** 2, self.scale[1] ** 2
         self.solver_velx = HholtzAdi(self.velx_space, (dt * nu / sx2, dt * nu / sy2))
@@ -724,10 +959,16 @@ class Navier2D(Integrate):
         with self._scope():
             self._build_bc_fields(xs, ys)
         if self._solid is not None:
-            # rebuilds the dt/eta factors AND recompiles the entry points
-            self.set_solid(
-                self._solid["mask"], self._solid["value"], self._solid["eta"]
-            )
+            # rebuilds the dt/eta factors AND recompiles the entry points;
+            # the obstacle itself is unchanged, so the per-rung cache stays
+            # valid (set_solid clears it — shield it across the call)
+            cache, self._dt_cache = self._dt_cache, {}
+            try:
+                self.set_solid(
+                    self._solid["mask"], self._solid["value"], self._solid["eta"]
+                )
+            finally:
+                self._dt_cache = cache
         else:
             self._compile_entry_points()
         self._obs_cache = None
@@ -781,7 +1022,13 @@ class Navier2D(Integrate):
 
     def exit(self) -> bool:
         """NaN-divergence break criterion
-        (/root/reference/src/navier_stokes/navier.rs:482-489)."""
+        (/root/reference/src/navier_stokes/navier.rs:482-489), extended by
+        the pre-divergence latch: a CFL-ceiling catch (sentinels armed)
+        reads as a break until a governor clears it — so an *ungoverned*
+        ``integrate`` over a sentinel-armed model stops cleanly at the
+        rolled-back (finite) state instead of looping forever."""
+        if self._pre_div_latch:
+            return True
         return bool(np.isnan(self.div_norm()))
 
     def reset_time(self) -> None:

@@ -23,7 +23,19 @@ Statuses: ``"time_limit"`` | ``"timestep_limit"`` | ``"break"`` (the model's
 
 from __future__ import annotations
 
+import math
+
 MAX_TIMESTEP = 10_000_000
+
+
+def _next_boundary(t: float, dt: float, save_intervall: float) -> float:
+    """First absolute save boundary ``k * save_intervall`` strictly after
+    ``t`` (half-dt tolerance, so a time that just landed on a boundary
+    targets the following one).  Working with the integer boundary index
+    keeps the save-window test exact at large ``t``, where the legacy
+    ``t % save_intervall`` form has lost the float resolution to place a
+    half-dt window reliably."""
+    return (math.floor((t + dt / 2.0) / save_intervall) + 1) * save_intervall
 
 
 class Integrate:
@@ -72,6 +84,9 @@ def integrate(
         return _integrate_chunked(pde, max_time, save_intervall, dispatch, on_chunk)
     timestep = 0
     eps_dt = pde.get_dt() * 1e-4
+    boundary = None
+    if save_intervall is not None:
+        boundary = _next_boundary(pde.get_time(), pde.get_dt(), save_intervall)
     while True:
         if dispatch is not None:
             dispatch(pde, 1)
@@ -81,8 +96,13 @@ def integrate(
 
         if save_intervall is not None:
             t, dt = pde.get_time(), pde.get_dt()
-            if (t % save_intervall) < dt / 2.0 or (t % save_intervall) > save_intervall - dt / 2.0:
-                pde.callback()
+            if t > boundary - dt / 2.0:
+                # inside the half-dt window around the tracked boundary —
+                # exact at large t (no modulo); past it (a dt change skipped
+                # across), just re-aim at the next boundary
+                if t < boundary + dt / 2.0:
+                    pde.callback()
+                boundary = _next_boundary(t, dt, save_intervall)
 
         if pde.get_time() + eps_dt >= max_time:
             print(f"time limit reached: {pde.get_time()}")
@@ -114,13 +134,10 @@ def _integrate_chunked(
         t = pde.get_time()
         if t + eps_dt >= max_time:
             break
+        boundary = None
         if save_intervall is not None:
-            # next boundary strictly after t (half-dt tolerance so a chunk
-            # that just landed on a boundary targets the following one)
-            import math
-
-            k_next = math.floor((t + dt / 2.0) / save_intervall) + 1
-            target = min(k_next * save_intervall, max_time)
+            boundary = _next_boundary(t, dt, save_intervall)
+            target = min(boundary, max_time)
         else:
             target = max_time
         n = max(1, round((target - t) / dt))
@@ -130,10 +147,12 @@ def _integrate_chunked(
         else:
             pde.update_n(n)
         timestep += n
-        if save_intervall is not None:
-            t_new = pde.get_time()
-            rem = t_new % save_intervall
-            if rem < dt / 2.0 or rem > save_intervall - dt / 2.0:
+        if boundary is not None:
+            # the chunk aimed at one absolute boundary; fire the callback
+            # only when the time actually landed in its half-dt window (a
+            # governed/preempted dispatch may have advanced less) — exact at
+            # large t, unlike the legacy ``t % save_intervall`` test
+            if abs(pde.get_time() - boundary) < dt / 2.0:
                 pde.callback()
         if timestep >= MAX_TIMESTEP:
             print(f"timestep limit reached: {timestep}")

@@ -22,12 +22,26 @@ import jax.numpy as jnp
 
 def run_scanned(step_n, state, n: int):
     """Advance ``n`` steps through ``step_n(state, bucket)`` in power-of-two
-    buckets, so arbitrary ``n`` costs at most log2(n) distinct XLA
-    compilations ever (a direct static-n scan would recompile for every new
-    chunk length, e.g. the tail of an integrate interval)."""
+    buckets (plus a single 3-bucket size), so arbitrary ``n`` costs at most
+    ~2*log2(n) distinct XLA compilations ever (a direct static-n scan would
+    recompile for every new chunk length, e.g. the tail of an integrate
+    interval).
+
+    Buckets of size 1 are avoided (except ``n == 1`` itself): XLA fully
+    inlines a ``length=1`` scan and re-fuses its body, which perturbs the
+    result at the last bit relative to the loop-compiled ``length>=2`` form
+    — an odd tail is dispatched as ``2+3`` instead of ``4+1`` so that two
+    program variants sharing the step math (the plain and sentinel-armed
+    chunks, models/navier.py) stay BIT-identical whenever their schedules
+    agree."""
     remaining = int(n)
     while remaining > 0:
-        bucket = 1 << (remaining.bit_length() - 1)
+        if remaining == 3:
+            bucket = 3
+        else:
+            bucket = 1 << (remaining.bit_length() - 1)
+            if bucket > 1 and remaining - bucket == 1:
+                bucket //= 2  # leave a 3-tail instead of a 1-tail
         state = step_n(state, bucket)
         remaining -= bucket
     return state

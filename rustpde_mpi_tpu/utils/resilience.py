@@ -12,11 +12,19 @@ module adds the production-harness layer on top of the ``integrate`` driver:
   chunk, checkpoint, journal and exit cleanly; on multihost meshes rank 0
   decides and the decision is broadcast so every host snapshots the same
   step,
-* **divergence recovery** — when the model's NaN break criterion fires, roll
-  back to the last good checkpoint, shrink dt by ``dt_backoff`` (rebuilding
-  the dt-baked solvers via ``set_dt``) and retry up to ``max_retries``;
-  ensembles can additionally respawn dead members from perturbed healthy
-  donors at rollback,
+* **proactive stability governance** — with a ``StabilityConfig`` the model
+  compiles on-device CFL/energy sentinels into its scanned chunks and a
+  :class:`~rustpde_mpi_tpu.utils.governor.StabilityGovernor` drives dt
+  toward a target Courant number on a rung-cached geometric ladder: a hard
+  CFL ceiling early-exits the chunk *before* NaNs appear and the recovery
+  is a cheap in-memory rollback (no checkpoint IO), with regrowth back up
+  the ladder after a healthy stretch (utils/governor.py),
+* **divergence recovery** — when the model's NaN break criterion fires (the
+  reactive last resort once the governor is out of ladder), roll back to
+  the last good checkpoint, shrink dt by ``dt_backoff`` (rebuilding the
+  dt-baked solvers via ``set_dt``, floored at ``dt_min``) and retry up to
+  ``max_retries``; ensembles can additionally respawn dead members from
+  perturbed healthy donors at rollback,
 * **hang watchdogs** — device dispatches run under a deadline
   (:func:`call_with_watchdog`); expiry dumps all-thread stacks via
   ``faulthandler`` and raises a structured :class:`DispatchHang` instead of
@@ -25,9 +33,10 @@ module adds the production-harness layer on top of the ``integrate`` driver:
   appended JSON line (step, time, Nu, wall seconds, attempt), so a campaign's
   failure history is machine-readable after the fact,
 * **deterministic fault injection** — ``RUSTPDE_FAULT=nan@<step>`` /
-  ``kill@<step>`` / ``slow@<step>`` (or the ``fault=`` argument) exercises
-  every recovery path in tests and ``bench.py`` without waiting for real
-  failures.
+  ``spike@<step>`` / ``kill@<step>`` / ``slow@<step>`` (or the ``fault=``
+  argument) exercises every recovery path — including every governor path,
+  via the finite velocity-spike incipient blow-up — in tests and
+  ``bench.py`` without waiting for real failures.
 
 This checkpoint/resume/watchdog shape is exactly the preemption-safe
 training-loop pattern (ROADMAP north star): swap "spectral coefficients" for
@@ -48,6 +57,7 @@ import time as _time
 import numpy as np
 
 from . import checkpoint
+from .governor import StabilityGovernor
 from .integrate import integrate
 
 
@@ -115,17 +125,22 @@ class FaultPlan:
     """Parsed ``RUSTPDE_FAULT`` spec: inject ``kind`` once when the run's
     global step counter reaches ``step``.
 
-    * ``nan``  — poison the state (every recovery path downstream of the
+    * ``nan``   — poison the state (every recovery path downstream of the
       model's NaN break criterion),
-    * ``kill`` — SIGTERM this process (the preemption path),
-    * ``slow`` — stall the next dispatch past the watchdog deadline (the
+    * ``spike`` — scale the velocity fields by ``spike_factor`` on-device:
+      the state stays *finite* but its CFL number blows past the sentinel
+      ceiling, so this exercises the stability governor's pre-divergence
+      catch + in-memory rollback + dt-ladder descent/regrowth — and, on an
+      ungoverned run, the incipient-blow-up-to-NaN path,
+    * ``kill``  — SIGTERM this process (the preemption path),
+    * ``slow``  — stall the next dispatch past the watchdog deadline (the
       :class:`DispatchHang` path)."""
 
     kind: str
     step: int
     fired: bool = False
 
-    KINDS = ("nan", "kill", "slow")
+    KINDS = ("nan", "spike", "kill", "slow")
 
     @classmethod
     def from_spec(cls, spec: str | None) -> "FaultPlan | None":
@@ -134,7 +149,7 @@ class FaultPlan:
         kind, sep, at = spec.partition("@")
         if kind not in cls.KINDS or not sep:
             raise ValueError(
-                f"bad fault spec {spec!r}: expected <nan|kill|slow>@<step>"
+                f"bad fault spec {spec!r}: expected <nan|spike|kill|slow>@<step>"
             )
         return cls(kind=kind, step=int(at))
 
@@ -149,6 +164,23 @@ def poison_state(pde) -> None:
         pde.state = jax.tree.map(lambda x: x * float("nan"), pde.state)
         if hasattr(pde, "mask") and hasattr(pde, "_finite_mask"):
             pde.mask = pde._finite_mask(pde.state)
+    pde._obs_cache = None
+
+
+def spike_state(pde, factor: float = 50.0) -> None:
+    """Scale the velocity fields by ``factor`` on-device: a deterministic
+    incipient blow-up — finite state, CFL far past the stability ceiling.
+    Under the governor this is caught pre-NaN (rollback happens in memory
+    and dt descends the ladder until the spiked flow is Courant-stable);
+    without sentinels the over-CFL explicit convection grows it into the
+    NaN divergence path within a few steps.  For ensembles the spike hits
+    every member (the state leaves carry the leading K axis)."""
+    scope = pde.model._scope if hasattr(pde, "model") else pde._scope
+    with scope():
+        st = pde.state
+        pde.state = st._replace(
+            velx=st.velx * factor, vely=st.vely * factor
+        )
     pde._obs_cache = None
 
 
@@ -192,12 +224,16 @@ class ResilientRunner:
         keep: int = 3,
         max_retries: int = 3,
         dt_backoff: float = 0.5,
+        dt_min: float = 0.0,
         respawn_members: bool = False,
         respawn_amp: float = 1e-3,
+        respawn_seed: int | None = None,
         dispatch_timeout_s: float | None = None,
         fault: str | None = None,
+        spike_factor: float | None = None,
         resume: bool = True,
         max_chunk_steps: int = 1024,
+        stability=None,
     ):
         self.pde = pde
         self.max_time = float(max_time)
@@ -208,8 +244,14 @@ class ResilientRunner:
         self.keep = int(keep)
         self.max_retries = int(max_retries)
         self.dt_backoff = float(dt_backoff)
+        # hard floor under the compounding divergence backoff AND the
+        # governor ladder default — without it repeated retries drive dt
+        # toward denormals (each one paying a solver refactorization for a
+        # step size that can no longer make progress)
+        self.dt_min = float(dt_min)
         self.respawn_members = bool(respawn_members)
         self.respawn_amp = float(respawn_amp)
+        self.respawn_seed = respawn_seed
         if dispatch_timeout_s is None:
             env = os.environ.get("RUSTPDE_DISPATCH_TIMEOUT_S", "")
             dispatch_timeout_s = float(env) if env else None
@@ -217,8 +259,20 @@ class ResilientRunner:
         self.fault = FaultPlan.from_spec(
             fault if fault is not None else os.environ.get("RUSTPDE_FAULT")
         )
+        if spike_factor is None:
+            env = os.environ.get("RUSTPDE_SPIKE_FACTOR", "")
+            spike_factor = float(env) if env else 50.0
+        self.spike_factor = float(spike_factor)
         self.resume = bool(resume)
         self.max_chunk_steps = int(max_chunk_steps)
+        # proactive stability governor (utils/governor.py): an explicit
+        # StabilityConfig wins; otherwise inherit sentinels the model
+        # already has armed (NavierConfig.stability -> set_stability)
+        self.stability = (
+            stability if stability is not None else getattr(pde, "_stability", None)
+        )
+        self.governor: StabilityGovernor | None = None
+        self._dt0 = float(pde.get_dt())  # governor ladder anchor (pre-resume)
         self.journal_path = os.path.join(run_dir, "journal.jsonl")
 
         self.step = 0  # global step counter (survives resume via ckpt attrs)
@@ -235,8 +289,14 @@ class ResilientRunner:
     @classmethod
     def from_config(cls, pde, rcfg, max_time, save_intervall=None, **overrides):
         """Build from a :class:`~rustpde_mpi_tpu.config.ResilienceConfig`
-        (``None`` uses the defaults); keyword overrides win."""
-        kwargs = dataclasses.asdict(rcfg) if rcfg is not None else {}
+        (``None`` uses the defaults); keyword overrides win.  A shallow
+        field copy, NOT ``dataclasses.asdict`` — the nested
+        ``StabilityConfig`` must arrive as the dataclass, not a dict."""
+        kwargs = (
+            {f.name: getattr(rcfg, f.name) for f in dataclasses.fields(rcfg)}
+            if rcfg is not None
+            else {}
+        )
         kwargs.update(overrides)
         return cls(pde, max_time, save_intervall, **kwargs)
 
@@ -444,7 +504,11 @@ class ResilientRunner:
 
     # -- dispatch (fault injection + watchdog) -------------------------------
 
-    def _update(self, pde, n: int) -> None:
+    def _update(self, pde, n: int):
+        """One watchdog-guarded dispatch; returns the model's
+        :class:`~rustpde_mpi_tpu.utils.governor.ChunkStatus` when stability
+        sentinels are armed (None otherwise)."""
+
         def work():
             if self._slow_pending:
                 self._slow_pending = False
@@ -452,8 +516,9 @@ class ResilientRunner:
                     max(2.0 * (self.dispatch_timeout_s or 0.0), 1.0)
                 )
             if hasattr(pde, "update_n"):
-                pde.update_n(n)
+                result = pde.update_n(n)
             else:
+                result = None
                 for _ in range(n):
                     pde.update()
             # force the device work into the deadline window: update_n
@@ -463,8 +528,9 @@ class ResilientRunner:
                 import jax
 
                 jax.block_until_ready(state)
+            return result
 
-        call_with_watchdog(
+        return call_with_watchdog(
             work, self.dispatch_timeout_s, label=f"update_n({n}) @ step {self.step}"
         )
 
@@ -475,15 +541,110 @@ class ResilientRunner:
         control back at a bounded cadence for signals and checkpoints.  The
         early break is root-decided, so every host stops after the same
         sub-chunk; returning with fewer steps advanced is safe — the
-        chunked driver re-reads ``pde.get_time()`` every iteration."""
+        chunked driver re-reads ``pde.get_time()`` every iteration.
+
+        With the governor active every sub-chunk's sentinel status is fed
+        through it here: a ``pre_divergence`` catch was already rolled back
+        in memory by ``update_n``, so the governor's dt/member decision is
+        applied and the loop returns (the driver re-plans at the new dt and
+        the same sim-time — that IS the retry)."""
         cap = self.max_chunk_steps if self.max_chunk_steps > 0 else n
         while n > 0:
             k = min(n, cap)
-            self._update(pde, k)
-            self.step += k
-            n -= k
+            dt_before = pde.get_dt()
+            status = self._update(pde, k)
+            if status is not None and self.governor is not None:
+                committed = self._govern(pde, status)
+                if committed:
+                    self.step += k
+                    n -= k
+                if not committed or pde.get_dt() != dt_before:
+                    # rolled back (retry at the governor's new dt) or dt
+                    # adjusted: the remaining step budget was planned at the
+                    # old dt — hand control back so the driver re-plans
+                    return
+            elif status is not None and status.pre_divergence:
+                # sentinels armed but no governor: leave the latch for the
+                # reactive path (exit() fires at the chunk boundary)
+                return
+            else:
+                self.step += k
+                n -= k
             if n > 0 and self._root_decides(self._interrupt is not None):
                 return  # integrate()'s on_chunk acts at the boundary
+
+    def _govern(self, pde, status) -> bool:
+        """Feed one chunk's sentinel status through the governor and apply
+        its decision; returns True when the chunk was committed (state
+        advanced), False when it was rolled back in memory."""
+        gov = self.governor
+        decision = gov.on_chunk(status, step=self.step)
+        self._journal(
+            {
+                "event": "cfl",
+                "cfl_max": status.cfl_max,
+                "ke": status.ke,
+                "ke_growth_max": status.ke_growth_max,
+                "div_max": status.div_max,
+                "dt": status.dt,
+                "rung": gov.rung,
+                "pre_divergence": status.pre_divergence,
+            }
+        )
+        if status.pre_divergence:
+            self._journal(
+                {
+                    "event": "pre_divergence",
+                    "cfl_max": status.cfl_max,
+                    "dt": status.dt,
+                    "steps_done": status.steps_done,
+                    "pinned": list(status.pinned) if status.pinned else None,
+                }
+            )
+            if decision.action == "retry":
+                pde.set_dt(decision.dt)
+                self._journal(
+                    {
+                        "event": "dt_adjust",
+                        "dt": decision.dt,
+                        "rung": gov.rung,
+                        "reason": decision.reason,
+                    }
+                )
+                pde.clear_pre_divergence()
+                return False
+            if decision.action == "kill_members":
+                pde.mark_dead(decision.members)
+                self._journal(
+                    {
+                        "event": "member_killed",
+                        "members": list(decision.members),
+                        "reason": decision.reason,
+                    }
+                )
+                if self.respawn_members and hasattr(pde, "respawn_dead"):
+                    respawned = pde.respawn_dead(
+                        amp=self.respawn_amp, seed=self._respawn_seed_arg()
+                    )
+                    self._journal({"event": "respawn", "respawned": respawned})
+                pde.clear_pre_divergence()
+                return False
+            # give_up: the ladder is exhausted — leave the latch set so
+            # integrate() returns "break" and the reactive checkpoint
+            # rollback (which may shrink dt below the ladder) takes over
+            self._journal({"event": "governor_giveup", "reason": decision.reason})
+            return False
+        if decision.action == "adjust":
+            pde.set_dt(decision.dt)
+            self._journal(
+                {
+                    "event": "dt_adjust",
+                    "dt": decision.dt,
+                    "rung": gov.rung,
+                    "reason": decision.reason,
+                }
+            )
+        return True
 
     def _dispatch(self, pde, n: int) -> None:
         fault = self.fault
@@ -506,6 +667,10 @@ class ResilientRunner:
                 os.kill(os.getpid(), signal.SIGTERM)
             elif fault.kind == "slow":
                 self._slow_pending = True
+            elif fault.kind == "spike":
+                # finite incipient blow-up: stepping continues below, so the
+                # sentinels (or, ungoverned, the NaN criterion) see it
+                spike_state(pde, self.spike_factor)
             rem = n - pre
             if rem > 0:
                 self._dispatch(pde, rem)
@@ -532,27 +697,74 @@ class ResilientRunner:
 
     # -- divergence recovery -------------------------------------------------
 
+    def _respawn_seed_arg(self):
+        """Seed handed to ``respawn_dead``: the config-carried campaign seed
+        (folded with step/attempt so every respawn draws fresh-but-
+        reproducible noise), the ensemble's own carried stream (``None``
+        lets it use it), or the legacy step+attempt fallback."""
+        if self.respawn_seed is not None:
+            return (int(self.respawn_seed), self.step, self.attempt)
+        if getattr(self.pde, "respawn_seed", None) is not None:
+            return None
+        return self.step + self.attempt
+
+    def _dt_trajectory(self) -> list:
+        """Every journaled dt change as ``(event, step, dt)`` — the evidence
+        trail :class:`DivergenceError` reports when retries are exhausted."""
+        traj = []
+        try:
+            with open(self.journal_path, encoding="utf-8") as fh:
+                for line in fh:
+                    try:
+                        rec = json.loads(line)
+                    except ValueError:
+                        continue
+                    dt = rec.get("dt")
+                    if dt is not None and rec.get("event") in (
+                        "start",
+                        "dt_restored",
+                        "dt_adjust",
+                        "retry",
+                        "divergence",
+                    ):
+                        traj.append((rec["event"], rec.get("step"), dt))
+        except OSError:
+            pass
+        return traj
+
     def _rollback(self) -> None:
         path = self._pick_checkpoint()
         if path is None:
             raise DivergenceError(
                 f"diverged at step {self.step} with no valid checkpoint in "
-                f"{self.run_dir!r} to roll back to"
+                f"{self.run_dir!r} to roll back to; journaled dt trajectory: "
+                f"{self._dt_trajectory()}"
             )
         attrs = checkpoint.read_attrs(path)  # latest_checkpoint verified it
         self.pde.read(path)
         self.step = int(attrs.get("step", 0))
+        if hasattr(self.pde, "clear_pre_divergence"):
+            # the restored checkpoint predates any latched sentinel catch
+            self.pde.clear_pre_divergence()
         # NOTE: deliberately no _restore_dt here — backoff compounds from
         # the CURRENT dt, so consecutive retries keep shrinking instead of
-        # resetting to the (larger) dt the rollback checkpoint was written at
+        # resetting to the (larger) dt the rollback checkpoint was written
+        # at — but never below the dt_min floor (a retry at a dt that can
+        # no longer make progress just burns refactorizations)
         new_dt = None
         if hasattr(self.pde, "set_dt") and 0.0 < self.dt_backoff < 1.0:
-            new_dt = self.pde.get_dt() * self.dt_backoff
-            self.pde.set_dt(new_dt)
+            new_dt = max(self.pde.get_dt() * self.dt_backoff, self.dt_min)
+            if new_dt != float(self.pde.get_dt()):
+                self.pde.set_dt(new_dt)
+        if self.governor is not None:
+            # keep the governor's rung honest after an off-ladder backoff
+            aligned = self.governor.align(float(self.pde.get_dt()), self.step)
+            if aligned is not None:
+                self.pde.set_dt(aligned)
         respawned = 0
         if self.respawn_members and hasattr(self.pde, "respawn_dead"):
             respawned = self.pde.respawn_dead(
-                amp=self.respawn_amp, seed=self.step + self.attempt
+                amp=self.respawn_amp, seed=self._respawn_seed_arg()
             )
         self._last_ckpt_time = float(self.pde.get_time())
         self._last_ckpt_path = path
@@ -561,6 +773,7 @@ class ResilientRunner:
                 "event": "retry",
                 "rollback_path": path,
                 "dt": float(self.pde.get_dt()) if new_dt is not None else None,
+                "dt_floor": bool(self.dt_min and new_dt == self.dt_min),
                 "respawned": respawned,
             }
         )
@@ -586,12 +799,14 @@ class ResilientRunner:
         self._install_signals()
         try:
             resumed = self._maybe_resume()
+            self._setup_governor()
             self._journal(
                 {
                     "event": "start",
                     "resumed": resumed,
                     "dt": float(pde.get_dt()),
                     "max_time": self.max_time,
+                    "governed": self.governor is not None,
                     "fault": dataclasses.asdict(self.fault) if self.fault else None,
                 }
             )
@@ -620,24 +835,62 @@ class ResilientRunner:
                     raise
                 if status in ("time_limit", "timestep_limit"):
                     self._checkpoint("final")
+                    self._journal_health()
                     self._journal({"event": "done", "status": status, "nu": self._nu()})
                     return self._summary("done")
                 if status == "stopped":
                     self._checkpoint("preempt")
+                    self._journal_health()
                     self._journal({"event": "preempted", "signal": self._interrupt})
                     return self._summary("preempted")
-                # status == "break": the model's NaN criterion fired
+                # status == "break": the model's NaN criterion fired (or a
+                # sentinel catch the governor gave up on)
                 self._journal({"event": "divergence", "dt": float(pde.get_dt())})
                 if self.attempt >= self.max_retries:
                     self._journal({"event": "giveup", "retries": self.attempt})
+                    self._journal_health()
                     raise DivergenceError(
                         f"diverged at step {self.step} and exhausted "
-                        f"{self.max_retries} retries (dt now {pde.get_dt():g})"
+                        f"{self.max_retries} retries (dt now {pde.get_dt():g}); "
+                        f"journaled dt trajectory: {self._dt_trajectory()}"
                     )
                 self.attempt += 1
                 self._rollback()
         finally:
             self._restore_signals()
+
+    def _setup_governor(self) -> None:
+        """Arm the sentinels + build the dt governor (run() start, after a
+        possible resume so an off-ladder restored dt can be re-aligned).
+        The ladder anchors at the dt the runner was CONSTRUCTED with — the
+        campaign's nominal dt — so a resumed backed-off run can climb back
+        to it; ``dt_min`` (when set) floors the ladder too."""
+        if self.stability is None or not hasattr(self.pde, "set_stability"):
+            return
+        if getattr(self.pde, "_stability", None) is not self.stability:
+            self.pde.set_stability(self.stability)
+        if getattr(self.pde, "_step_n_sent", None) is None:
+            return  # GSPMD-fallback path: set_stability already warned
+        cfg = self.stability
+        if cfg.dt_min is None and self.dt_min > 0.0:
+            cfg = dataclasses.replace(cfg, dt_min=min(self.dt_min, self._dt0))
+        self.governor = StabilityGovernor(cfg, self._dt0)
+        aligned = self.governor.align(float(self.pde.get_dt()), self.step)
+        if aligned is not None:
+            self.pde.set_dt(aligned)
+            self._journal(
+                {
+                    "event": "dt_adjust",
+                    "dt": aligned,
+                    "rung": self.governor.rung,
+                    "reason": "resumed dt quantized to the governor ladder",
+                }
+            )
+
+    def _journal_health(self) -> None:
+        """End-of-run physics health summary (governed runs)."""
+        if self.governor is not None:
+            self._journal({"event": "run_health", **self.governor.health.asdict()})
 
     def _summary(self, outcome: str) -> dict:
         return {
@@ -651,4 +904,9 @@ class ResilientRunner:
             # tracked, not re-scanned: latest_checkpoint re-hashes every
             # file, which is pure waste for multi-GB snapshots
             "checkpoint": self._last_ckpt_path,
+            # physics health telemetry (governed runs): dt trajectory,
+            # sentinel extrema, pre-divergence catches / rollbacks avoided
+            "health": (
+                self.governor.health.asdict() if self.governor is not None else None
+            ),
         }

@@ -27,6 +27,10 @@ _CASES = {
     "navier_rbc_resilient.py": [
         "--quick", "--max-time", "0.2", "--fault", "nan@8", "--retries", "1",
     ],
+    "navier_rbc_governed.py": [
+        "--quick", "--max-time", "0.5", "--fault", "spike@8",
+        "--spike-factor", "100", "--grow-after", "2",
+    ],
     "navier_rbc_roughness.py": ["--quick"],
     "navier_mpi.py": ["--quick"],
     "navier_rbc_steady.py": ["--quick"],
