@@ -41,6 +41,11 @@ from .utils.governor import (  # noqa: F401
     StabilityGovernor,
 )
 from .utils.integrate import Integrate, integrate  # noqa: F401
+from .utils.io_pipeline import (  # noqa: F401
+    AsyncWriteError,
+    IOPipeline,
+    ObservableFuture,
+)
 from .utils.resilience import (  # noqa: F401
     DispatchHang,
     DivergenceError,

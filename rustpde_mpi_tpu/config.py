@@ -157,6 +157,45 @@ class StabilityConfig:
 
 
 @dataclass
+class IOConfig:
+    """Knobs for the overlapped I/O pipeline (utils/io_pipeline.py).
+
+    * ``async_checkpoints`` — cadence checkpoints are fetched to host on the
+      main thread (:func:`~rustpde_mpi_tpu.utils.checkpoint.snapshot_to_host`,
+      the one device sync a checkpoint inherently needs) and serialized +
+      digest-stamped + fsynced on a background worker while the device steps
+      the next chunks.  Edge checkpoints (anchor/final/preempt) stay
+      effectively synchronous — the runner drains right after submitting
+      them — and multihost meshes disable the whole pipeline (async writes
+      AND dispatch overlap): the write-failure barrier must stay collective,
+      and a lagged break check resolving on per-host device timing would
+      desynchronize the collective dispatch sequence.
+      Durability is unchanged: writes are still atomic and verified, the
+      writer drains before any rollback/resume read, and a write failure
+      re-raises at the next submit/drain.
+    * ``overlap_dispatch`` — dispatch double-buffering in the chunked
+      driver: break checks + callback observables ride futures (one-chunk
+      lag, see ``integrate(overlap=...)``) instead of fencing the device
+      queue every boundary.
+    * ``queue_depth`` — bounded in-flight background writes: a submission
+      past the depth blocks (back-pressure), so host memory holds at most
+      ``queue_depth`` pending snapshots and cadence can never outrun disk.
+    * ``diag_lag`` — boundaries a diagnostics emission may trail the device
+      before the callback blocks for it (0 = synchronous printing).
+    """
+
+    async_checkpoints: bool = True
+    overlap_dispatch: bool = True
+    queue_depth: int = 1
+    diag_lag: int = 1
+
+    @classmethod
+    def blocking(cls) -> "IOConfig":
+        """Fully synchronous IO (the pre-pipeline behavior)."""
+        return cls(async_checkpoints=False, overlap_dispatch=False, diag_lag=0)
+
+
+@dataclass
 class ResilienceConfig:
     """Knobs for :class:`~rustpde_mpi_tpu.utils.resilience.ResilientRunner`
     (field names match the runner's keyword arguments; build one via
@@ -186,6 +225,9 @@ class ResilienceConfig:
     dispatch_timeout_s: float | None = None
     resume: bool = True
     stability: StabilityConfig | None = None
+    # overlapped-IO pipeline knobs (None = IOConfig() defaults: async
+    # cadence checkpoints + dispatch double-buffering ON)
+    io: IOConfig | None = None
 
 
 @dataclass

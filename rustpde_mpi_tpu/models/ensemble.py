@@ -55,6 +55,11 @@ class NavierEnsemble(Integrate):
     share ``model``'s spaces, solvers and parameters; only the state differs.
     """
 
+    # overlapped-IO hooks — see Navier2D: class-level defaults keep plain
+    # ensembles fully synchronous
+    io_pipeline = None
+    io_overlap = False
+
     def __init__(self, model: Navier2D, states):
         if isinstance(states, NavierState):
             if np.ndim(states.temp) != np.ndim(model.state.temp) + 1:
@@ -384,13 +389,31 @@ class NavierEnsemble(Integrate):
 
     def _update_n_sentinel(self, n: int):
         """Sentinel-armed batched chunk (see :meth:`update_n`)."""
+        return self.update_n_pending(n).resolve()
+
+    def update_n_pending(self, n: int):
+        """Batched sentinel chunk with a DEFERRED commit decision — the
+        ensemble form of :meth:`Navier2D.update_n_pending` (the lag=1
+        contract of the overlapped driver): state/mask/counters advance
+        PROVISIONALLY at dispatch, and ``resolve()`` fetches the per-member
+        sentinels in one transfer, rolling the whole chunk back (and
+        latching ``exit()``) when any member pinned the CFL ceiling.  The
+        previous chunk-start ``steps_done`` rides the same deferred fetch —
+        the synchronous form used to pay a blocking pre-dispatch read for
+        it."""
         from .. import config
         from ..utils.governor import ChunkStatus
+        from ..utils.io_pipeline import PendingChunkStatus
         from ..utils.jit import run_scanned
 
+        if self._step_n_sent is None:
+            raise RuntimeError(
+                "update_n_pending requires armed stability sentinels "
+                "(set_stability)"
+            )
         self._pre_div_latch = False
         rdt = config.real_dtype()
-        done_before = np.asarray(self.steps_done).copy()
+        done_before = self.steps_done  # fetched with the sentinel scalars
         with self.model._scope():
             # distinct buffers per slot: the dispatch donates the whole
             # carry, and donation rejects the same buffer appearing twice
@@ -406,35 +429,45 @@ class NavierEnsemble(Integrate):
             )
             carry = run_scanned(lambda c, k: self._step_n_sent(c, k), carry, n)
         st, fin, cok, dn, cflm, gm, dvm, kep = carry
-        fin_h = np.asarray(fin)
-        pinned = fin_h & ~np.asarray(cok)
-        pre_div = bool(pinned.any())
-        if pre_div:
-            # in-memory rollback of the whole chunk: state/mask/counters are
-            # the un-donated chunk-start snapshots — keep them
-            self._pre_div_latch = True
-        else:
-            self.state, self.mask, self.steps_done = st, fin, dn
-            self.time += n * self.dt
-        cflm_h = np.asarray(cflm)
-        delta = np.asarray(dn) - done_before
-        status = ChunkStatus(
-            requested=int(n),
-            steps_done=int(delta.max(initial=0)),
-            finite=bool(fin_h.any()),
-            cfl_ok=not pre_div,
-            pre_divergence=pre_div,
-            cfl_max=float(cflm_h.max(initial=0.0)),  # the batch-max reduction
-            ke=float(np.asarray(kep).max(initial=0.0)),
-            ke_growth_max=float(np.asarray(gm).max(initial=0.0)),
-            div_max=float(np.asarray(dvm).max(initial=0.0)),
-            dt=self.dt,
-            cfl_members=tuple(float(c) for c in cflm_h),
-            pinned=tuple(bool(p) for p in pinned),
-        )
-        self.last_chunk_status = status
+        snapshot = (self.state, self.mask, self.steps_done, self.time)
+        self.state, self.mask, self.steps_done = st, fin, dn  # provisional
+        self.time += n * self.dt
         self._obs_cache = None
-        return status
+        dt = self.dt
+
+        def finish(fetched):
+            fin_h, cok_h, dn_h, cflm_h, gm_h, dvm_h, kep_h, before_h = (
+                np.asarray(a) for a in fetched
+            )
+            pinned = fin_h & ~cok_h
+            pre_div = bool(pinned.any())
+            if pre_div:
+                # in-memory rollback of the whole chunk: state/mask/counters
+                # are the un-donated chunk-start snapshots — put them back
+                (self.state, self.mask, self.steps_done, self.time) = snapshot
+                self._pre_div_latch = True
+                self._obs_cache = None
+            delta = dn_h - before_h
+            status = ChunkStatus(
+                requested=int(n),
+                steps_done=int(delta.max(initial=0)),
+                finite=bool(fin_h.any()),
+                cfl_ok=not pre_div,
+                pre_divergence=pre_div,
+                cfl_max=float(cflm_h.max(initial=0.0)),  # batch-max reduction
+                ke=float(kep_h.max(initial=0.0)),
+                ke_growth_max=float(gm_h.max(initial=0.0)),
+                div_max=float(dvm_h.max(initial=0.0)),
+                dt=dt,
+                cfl_members=tuple(float(c) for c in cflm_h),
+                pinned=tuple(bool(p) for p in pinned),
+            )
+            self.last_chunk_status = status
+            return status
+
+        return PendingChunkStatus(
+            (fin, cok, dn, cflm, gm, dvm, kep, done_before), finish
+        )
 
     @property
     def _stability(self):
@@ -564,20 +597,47 @@ class NavierEnsemble(Integrate):
             return True
         return not bool(np.any(self.alive()))
 
+    def exit_future(self):
+        """Non-blocking :meth:`exit` for the overlapped driver: the
+        all-members-dead reduction rides the device queue (the mask is
+        maintained on device by the chunked step) and resolves when the
+        driver fetches it — a latched sentinel catch resolves immediately."""
+        import jax.numpy as jnp
+
+        from ..utils.io_pipeline import ObservableFuture, immediate
+
+        if self._pre_div_latch:
+            return immediate(True)
+        with self.model._scope():
+            dead = jnp.logical_not(jnp.any(self.mask))
+        return ObservableFuture(dead, convert=bool)
+
     # -- observables / IO ----------------------------------------------------
+
+    def get_observables_async(self):
+        """Dispatch the vmapped observables and return an
+        :class:`~rustpde_mpi_tpu.utils.io_pipeline.ObservableFuture` (shape
+        ``(K,)`` per entry) without waiting — cached per state and shared
+        with the synchronous accessors, like the single-run form."""
+        from ..utils.io_pipeline import ObservableFuture
+
+        if self._obs_cache is None or self._obs_cache[0] is not self.state:
+            with self.model._scope():
+                fut = ObservableFuture(
+                    self._obs_fn(self.state),
+                    convert=lambda vals: tuple(np.asarray(v) for v in vals),
+                )
+            self._obs_cache = (self.state, fut)
+        return self._obs_cache[1]
 
     def get_observables(self) -> tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
         """(Nu, Nuvol, Re, |div|), each a float ndarray of shape (K,) — one
-        fused vmapped dispatch, cached per state.  NOTE a member that
-        diverged mid-run is frozen at its last FINITE state, so its entries
-        are finite but STALE; only a member whose IC was already non-finite
-        reports NaN.  Liveness is :meth:`alive` / ``mask``, not
-        ``isfinite(nu)``."""
-        if self._obs_cache is None or self._obs_cache[0] is not self.state:
-            with self.model._scope():
-                values = tuple(np.asarray(v) for v in self._obs_fn(self.state))
-            self._obs_cache = (self.state, values)
-        return self._obs_cache[1]
+        fused vmapped dispatch, cached per state, fetched in ONE host
+        transfer.  NOTE a member that diverged mid-run is frozen at its last
+        FINITE state, so its entries are finite but STALE; only a member
+        whose IC was already non-finite reports NaN.  Liveness is
+        :meth:`alive` / ``mask``, not ``isfinite(nu)``."""
+        return self.get_observables_async().result()
 
     def eval_nu(self) -> np.ndarray:
         return self.get_observables()[0]
@@ -591,13 +651,10 @@ class NavierEnsemble(Integrate):
     def div_norm(self) -> np.ndarray:
         return self.get_observables()[3]
 
-    def callback(self) -> None:
-        """Per-interval reporting: append per-member diagnostics, print an
-        aggregate line, write the ensemble snapshot when ``write_intervall``
-        says so (the single-run callback's throttling rule)."""
-        nu, nuvol, re, div = self.get_observables()
-        alive = self.alive()
-        t = self.time
+    def _emit_callback_line(self, t: float, vals, alive: np.ndarray) -> None:
+        """Diagnostics append + aggregate print for one boundary (shared by
+        the synchronous path and the io_pipeline's lagged emission)."""
+        nu, nuvol, re, div = vals
         for key, val in (
             ("time", [t] * self.k),
             ("nu", nu),
@@ -609,19 +666,59 @@ class NavierEnsemble(Integrate):
             self.diagnostics.setdefault(key, []).append(list(map(float, val)))
         n_alive = int(alive.sum())
         if n_alive:
-            live = nu[alive]
+            live = np.asarray(nu)[alive]
             nu_info = f"Nu = {live.mean():5.3e} [{live.min():5.3e}, {live.max():5.3e}]"
         else:
             nu_info = "Nu = --- (all members diverged)"
         print(f"time = {t:9.3f}      alive = {n_alive}/{self.k}      {nu_info}")
+
+    def callback(self) -> None:
+        """Per-interval reporting: append per-member diagnostics, print an
+        aggregate line, write the ensemble snapshot when ``write_intervall``
+        says so (the single-run callback's throttling rule).
+
+        With an attached ``io_pipeline`` the diagnostics ride observable
+        futures (emitted at most one boundary late, FIFO) and the snapshot
+        serialization runs on the background worker — the device queue is
+        never fenced at the boundary (see utils/navier_io.callback)."""
+        t = self.time
+        pipeline = self.io_pipeline
+        if pipeline is not None:
+            from ..utils.io_pipeline import ObservableFuture
+
+            obs_fut = self.get_observables_async()
+            # the mask rides the same device queue as the observables: when
+            # the obs future resolves, this fetch is already complete
+            mask_fut = ObservableFuture(self.mask, convert=np.asarray)
+
+            def emit(vals, t=t):
+                self._emit_callback_line(t, vals, mask_fut.result().astype(bool))
+
+            pipeline.push_diag(emit, obs_fut)
+        else:
+            self._emit_callback_line(t, self.get_observables(), self.alive())
         # single-run rule (utils/navier_io.callback): write every save
         # interval unless write_intervall throttles it further
         wi = self.write_intervall
         if wi is None or (t + self.dt / 2.0) % wi < self.dt:
-            try:
-                self.write(f"data/ensemble{t:08.2f}.h5")
-            except OSError as exc:  # never fatal, like the single-run callback
-                print(f"unable to write ensemble snapshot: {exc}")
+            fname = f"data/ensemble{t:08.2f}.h5"
+            if pipeline is not None:
+                from ..utils import checkpoint
+
+                snap = checkpoint.ensemble_snapshot_to_host(self)
+
+                def write_snap(snap=snap, fname=fname):
+                    try:
+                        checkpoint.write_host_snapshot(snap, fname)
+                    except OSError as exc:
+                        print(f"unable to write ensemble snapshot: {exc}")
+
+                pipeline.submit_write(write_snap, fname, nbytes=snap.nbytes)
+            else:
+                try:
+                    self.write(fname)
+                except OSError as exc:  # never fatal, like the single-run callback
+                    print(f"unable to write ensemble snapshot: {exc}")
 
     def write(self, filename: str) -> None:
         """Write a K-member snapshot (per-member groups, utils/checkpoint)."""

@@ -191,6 +191,14 @@ class Navier2D(Integrate):
     # one warning per process, not per model)
     _warned_split_sep_fallback = False
 
+    # overlapped-IO hooks (utils/io_pipeline.py): an attached IOPipeline
+    # routes callback IO (flow snapshots, diagnostics lines) through the
+    # background writer / lag queue, and io_overlap opts the chunked driver
+    # into lagged break checks (utils/integrate.py).  Class-level defaults
+    # keep plain models fully synchronous.
+    io_pipeline = None
+    io_overlap = False
+
     def _gspmd_split_sep_fallback(self) -> bool:
         """True when the FUSED jitted step would be miscompiled: GSPMD
         miscompiles the fused split-sep periodic step under an active mesh
@@ -816,9 +824,31 @@ class Navier2D(Integrate):
     def _update_n_sentinel(self, n: int):
         """Sentinel-armed chunk: scan with CFL/KE/|div| reductions riding the
         carry, one scalar fetch at the end (the only extra host sync)."""
+        return self.update_n_pending(n).resolve()
+
+    def update_n_pending(self, n: int):
+        """Sentinel-armed chunk with a DEFERRED commit decision (the lag=1
+        contract of the overlapped driver, utils/io_pipeline.py): dispatch
+        the scanned chunk, PROVISIONALLY advance ``state``/``time`` to its
+        end, and return a
+        :class:`~rustpde_mpi_tpu.utils.io_pipeline.PendingChunkStatus` whose
+        ``resolve()`` fetches the sentinel scalars and either confirms the
+        advance or restores the chunk-start snapshot (+ latches ``exit()``)
+        — exactly the synchronous :meth:`update_n` outcome, decided one
+        host round-trip later.  The governed runner dispatches chunk i+1
+        from the provisional state before resolving chunk i, so the device
+        queue never drains while the governor reads the sentinels; the
+        on-device CFL ceiling guards the speculative chunk (it steps a
+        frozen, finite state when chunk i tripped)."""
         from ..utils.governor import ChunkStatus
+        from ..utils.io_pipeline import PendingChunkStatus
         from ..utils.jit import run_scanned
 
+        if self._step_n_sent is None:
+            raise RuntimeError(
+                "update_n_pending requires armed stability sentinels "
+                "(set_stability)"
+            )
         self._pre_div_latch = False
         rdt = config.real_dtype()
         with self._scope():
@@ -835,30 +865,37 @@ class Navier2D(Integrate):
             )
             carry = run_scanned(lambda c, k: self._step_n_sent(c, k), carry, n)
         st, fin, cok, done, cflm, gm, dvm, ke = carry
-        fin, cok = bool(fin), bool(cok)
-        pre_div = fin and not cok
-        if pre_div:
-            # in-memory rollback: the dispatch stepped a donated COPY, so
-            # self.state still holds the chunk-start snapshot — keep it,
-            # leave time untouched, and latch exit() until a governor acts
-            self._pre_div_latch = True
-        else:
-            self.state = st
-            self.time += n * self.dt
-        status = ChunkStatus(
-            requested=int(n),
-            steps_done=int(done),
-            finite=fin,
-            cfl_ok=cok,
-            pre_divergence=pre_div,
-            cfl_max=float(cflm),
-            ke=float(ke),
-            ke_growth_max=float(gm),
-            div_max=float(dvm),
-            dt=self.dt,
-        )
-        self.last_chunk_status = status
-        return status
+        snapshot = (self.state, self.time)
+        self.state = st  # provisional: resolve() confirms or restores
+        self.time += n * self.dt
+        dt = self.dt
+
+        def finish(fetched):
+            fin_h, cok_h, done_h, cflm_h, gm_h, dvm_h, ke_h = fetched
+            fin_b, cok_b = bool(fin_h), bool(cok_h)
+            pre_div = fin_b and not cok_b
+            if pre_div:
+                # in-memory rollback: the dispatch stepped a donated COPY,
+                # so the snapshot still holds the chunk-start state — put it
+                # back and latch exit() until a governor acts
+                self.state, self.time = snapshot
+                self._pre_div_latch = True
+            status = ChunkStatus(
+                requested=int(n),
+                steps_done=int(done_h),
+                finite=fin_b,
+                cfl_ok=cok_b,
+                pre_divergence=pre_div,
+                cfl_max=float(cflm_h),
+                ke=float(ke_h),
+                ke_growth_max=float(gm_h),
+                div_max=float(dvm_h),
+                dt=dt,
+            )
+            self.last_chunk_status = status
+            return status
+
+        return PendingChunkStatus((fin, cok, done, cflm, gm, dvm, ke), finish)
 
     def set_stability(self, cfg) -> None:
         """Arm/disarm (``None``) the on-device stability sentinels
@@ -973,14 +1010,31 @@ class Navier2D(Integrate):
             self._compile_entry_points()
         self._obs_cache = None
 
-    def get_observables(self) -> tuple[float, float, float, float]:
-        """(Nu, Nuvol, Re, |div|) — one fused device dispatch, cached per
-        state so callback printing + exit checks don't recompute."""
+    def get_observables_async(self):
+        """Dispatch the fused ``(Nu, Nuvol, Re, |div|)`` computation and
+        return an :class:`~rustpde_mpi_tpu.utils.io_pipeline.ObservableFuture`
+        WITHOUT waiting for it — the device keeps working while the host
+        decides when (if ever) to fetch.  Cached per state, shared with the
+        synchronous accessors and :meth:`exit_future`, so diagnostics + break
+        checks cost ONE dispatch and ONE host transfer per state."""
+        from ..utils.io_pipeline import ObservableFuture
+
         if self._obs_cache is None or self._obs_cache[0] is not self.state:
             with self._scope():
-                values = tuple(float(v) for v in self._obs_fn(self.state))
-            self._obs_cache = (self.state, values)
+                fut = ObservableFuture(
+                    self._obs_fn(self.state),
+                    convert=lambda vals: tuple(float(v) for v in vals),
+                )
+            self._obs_cache = (self.state, fut)
         return self._obs_cache[1]
+
+    def get_observables(self) -> tuple[float, float, float, float]:
+        """(Nu, Nuvol, Re, |div|) — one fused device dispatch, cached per
+        state so callback printing + exit checks don't recompute.  The four
+        scalars arrive in ONE host transfer (the future's ``device_get``),
+        not four sequential blocking conversions — through the TPU relay
+        each round-trip costs ~110 ms."""
+        return self.get_observables_async().result()
 
     def eval_nu(self) -> float:
         return self.get_observables()[0]
@@ -1030,6 +1084,21 @@ class Navier2D(Integrate):
         if self._pre_div_latch:
             return True
         return bool(np.isnan(self.div_norm()))
+
+    def exit_future(self):
+        """Non-blocking form of :meth:`exit` for the overlapped driver
+        (utils/integrate.py ``overlap``): a latched pre-divergence catch
+        resolves immediately (host-side fact); otherwise the break flag
+        rides the cached observables dispatch and is fetched when the
+        driver gets around to it — typically one chunk later, after the
+        next chunk is already in flight."""
+        from ..utils.io_pipeline import MappedFuture, immediate
+
+        if self._pre_div_latch:
+            return immediate(True)
+        return MappedFuture(
+            self.get_observables_async(), lambda vals: bool(np.isnan(vals[3]))
+        )
 
     def reset_time(self) -> None:
         self.time = 0.0

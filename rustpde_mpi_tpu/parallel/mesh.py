@@ -78,13 +78,34 @@ def sharding(spec: tuple, ndim: int | None = None) -> NamedSharding | None:
     return NamedSharding(mesh, PartitionSpec(*spec))
 
 
+# Small arrays whose sharded dim does not divide the mesh are PLACED fully
+# replicated instead of being left uncommitted: a tiny parameter (the
+# f64[9,17] `pres` in MULTICHIP_r05.json) that enters a dispatch with a
+# leftover compiler-chosen partial sharding (e.g. [2,1,4]
+# last_tile_dim_replicate) that the executable's parameter layout cannot
+# consume forces an "[SPMD] Involuntary full rematerialization" — a full
+# replicate-then-repartition on EVERY dispatch.  An explicitly replicated
+# input is the one layout every executable can consume with at worst a
+# local slice.  Large non-divisible arrays (the odd spectral sizes 129,
+# 1025, ...) are still left to the in-jit padded constraints — replication
+# there would be real memory.
+REPLICATE_MAX_ELEMS = 1 << 14
+
+
 def constrain(x, spec: tuple):
     """Pin ``x`` to a pencil layout inside a jitted computation; no-op without
     an active mesh.  This is the TPU equivalent of the reference's
     transpose_x_to_y/transpose_y_to_x calls — the collective itself is left
     to XLA.  Outside a trace (eager setup code) it becomes a resharding.
     Arrays with more dims than the spec treat the extra leading dims as
-    replicated batch."""
+    replicated batch.
+
+    NOTE in-jit constraints deliberately do NOT take the small-array
+    replicated pin below: the pencil-flip constraint pattern inside the
+    transforms is what the serial==sharded 1e-12 equality tests validate,
+    and rewriting it for small grids changes GSPMD's fusion choices (the
+    17^2/33x32 sharded test grids all sit under any useful size
+    threshold).  Only EAGER placement (``device_put``) canonicalizes."""
     s = sharding(spec, np.ndim(x))
     if s is None:
         return x
@@ -134,4 +155,11 @@ def device_put(x, spec: tuple):
     )
     if divisible:
         return jax.device_put(arr, s)
+    if arr.size <= REPLICATE_MAX_ELEMS:
+        # explicit replication is always a legal placement; it also matches
+        # the in-jit constraint for the same array (see constrain), so no
+        # executable ever has to repartition it involuntarily
+        return jax.device_put(
+            arr, NamedSharding(mesh, PartitionSpec(*([None] * arr.ndim)))
+        )
     return arr

@@ -37,6 +37,7 @@ Durability (utils/resilience.py rides on these guarantees):
 
 from __future__ import annotations
 
+import dataclasses
 import hashlib
 import os
 from contextlib import contextmanager
@@ -70,6 +71,13 @@ class CheckpointError(RuntimeError):
         self.filename = filename
 
 
+def _digest_update(digest, name: str, data: np.ndarray) -> None:
+    digest.update(name.encode("utf-8") + b"\0")
+    digest.update(str(data.dtype).encode() + b"\0")
+    digest.update(str(data.shape).encode() + b"\0")
+    digest.update(data.tobytes())
+
+
 def content_digest(h5) -> str:
     """sha256 over every dataset (path + shape + dtype + raw bytes, visited
     in sorted path order).  Root *attrs* are deliberately excluded so the
@@ -85,11 +93,7 @@ def content_digest(h5) -> str:
     h5.visititems(visit)
     digest = hashlib.sha256()
     for name in sorted(paths):
-        data = np.ascontiguousarray(h5[name][()])
-        digest.update(name.encode("utf-8") + b"\0")
-        digest.update(str(data.dtype).encode() + b"\0")
-        digest.update(str(data.shape).encode() + b"\0")
-        digest.update(data.tobytes())
+        _digest_update(digest, name, np.ascontiguousarray(h5[name][()]))
     return digest.hexdigest()
 
 
@@ -154,18 +158,84 @@ def verify_snapshot(filename: str) -> dict:
         return _verify_open_file(h5, filename)
 
 
+@dataclasses.dataclass
+class HostSnapshot:
+    """A snapshot fully fetched to host memory, not yet on disk.
+
+    ``datasets`` is an ordered list of ``(h5path, array, kind)`` where
+    ``kind`` is ``"field"`` (written through :func:`_write_array`: float64
+    cast, complex split into ``_re``/``_im``) or ``"raw"`` (stored with the
+    array's exact dtype — counters, masks, scalars).  The object is
+    device-free: building one (:func:`snapshot_to_host` /
+    :func:`ensemble_snapshot_to_host`) is the only part of a checkpoint
+    that needs the model, so serialization + digest + fsync can run on a
+    background thread (utils/io_pipeline.AsyncCheckpointWriter) while the
+    device steps the next chunk."""
+
+    datasets: list
+    step: int | None = None
+    time: float | None = None
+    dt: float | None = None
+
+    @property
+    def nbytes(self) -> int:
+        return sum(int(np.asarray(d).nbytes) for _, d, _ in self.datasets)
+
+
+def _stored_arrays(path: str, data, kind: str):
+    """The ``(name, array)`` pairs exactly as the writers lay them down on
+    disk — the complex split and float64 cast :func:`_write_array` applies
+    for ``"field"`` entries, the identity for ``"raw"`` ones."""
+    if kind != "field":
+        return [(path, np.ascontiguousarray(data))]
+    if np.iscomplexobj(data):
+        return [
+            (
+                f"{path}_re",
+                np.asarray(np.ascontiguousarray(data.real), dtype=np.float64),
+            ),
+            (
+                f"{path}_im",
+                np.asarray(np.ascontiguousarray(data.imag), dtype=np.float64),
+            ),
+        ]
+    return [(path, np.asarray(data, dtype=np.float64))]
+
+
+def snapshot_digest(datasets) -> str:
+    """The :func:`content_digest` a file holding ``datasets`` will have,
+    computed from the in-memory arrays — so the write path never re-reads
+    the file it just wrote (for multi-GB snapshots the read-back pass
+    doubled checkpoint IO).  Byte-for-byte the same hash: the stored forms
+    (:func:`_stored_arrays`) are hashed in the same sorted-path order
+    ``content_digest`` visits, and a roundtrip is CI-asserted
+    (tests/test_io_pipeline.py)."""
+    expanded = []
+    for path, data, kind in datasets:
+        expanded.extend(_stored_arrays(path, data, kind))
+    digest = hashlib.sha256()
+    for name, arr in sorted(expanded, key=lambda kv: kv[0]):
+        _digest_update(digest, name, np.ascontiguousarray(arr))
+    return digest.hexdigest()
+
+
 def _atomic_h5_write(
     filename: str,
     body,
     step: int | None = None,
     time: float | None = None,
     dt: float | None = None,
+    digest_items=None,
 ) -> None:
     """Write an HDF5 file atomically: ``body(h5)`` fills a ``.tmp`` sibling,
     root attrs (schema/step/time + content digest) are stamped, the file is
     flushed + fsynced, then ``os.replace``d over the target (and the
     directory fsynced) — no code path can leave a truncated file where a
-    previously valid checkpoint existed."""
+    previously valid checkpoint existed.
+
+    ``digest_items`` (a :class:`HostSnapshot` ``datasets`` list) lets the
+    digest be computed from the in-memory arrays instead of re-reading
+    every dataset back out of the file just written."""
     import h5py
 
     dirname = os.path.dirname(filename) or "."
@@ -183,7 +253,11 @@ def _atomic_h5_write(
                 # the step size the run was using — resume restores it so a
                 # backed-off dt survives preemption (utils/resilience.py)
                 h5.attrs["dt"] = float(dt)
-            h5.attrs["digest"] = content_digest(h5)
+            h5.attrs["digest"] = (
+                snapshot_digest(digest_items)
+                if digest_items is not None
+                else content_digest(h5)
+            )
             h5.flush()
         fd = os.open(tmp, os.O_RDONLY)
         try:
@@ -399,27 +473,138 @@ def _model_coords(model):
     return xs, dxs
 
 
+def _field_host_datasets(path: str, space, vhat, v_phys, x, dx) -> list:
+    """Host dataset list for one variable group — exactly the layout
+    :func:`write_field` lays down (``v_phys`` is the already-dispatched
+    physical field; ``vhat_as_complex`` fetches the coefficients)."""
+    return [
+        (f"{path}/x", np.asarray(x[0]), "field"),
+        (f"{path}/dx", np.asarray(dx[0]), "field"),
+        (f"{path}/y", np.asarray(x[1]), "field"),
+        (f"{path}/dy", np.asarray(dx[1]), "field"),
+        (f"{path}/v", np.asarray(v_phys), "field"),
+        (f"{path}/vhat", space.vhat_as_complex(vhat), "field"),
+    ]
+
+
+def snapshot_to_host(model, step: int | None = None) -> HostSnapshot:
+    """Fetch a flow snapshot into host memory WITHOUT touching disk.
+
+    The one device sync a checkpoint inherently needs: every backward
+    transform is dispatched first (the device pipelines them), then the
+    results are fetched.  The returned :class:`HostSnapshot` feeds
+    :func:`write_host_snapshot` — synchronously (:func:`write_snapshot`) or
+    on the io_pipeline worker, off the dispatch critical path."""
+    xs, dxs = _model_coords(model)
+    datasets: list = []
+    with model._scope():
+        phys = {
+            attr: getattr(model, f"{attr}_space").backward(
+                getattr(model.state, attr)
+            )
+            for _, attr in _VARS
+        }
+        tempbc = getattr(model, "tempbc_ortho", None)
+        phys_bc = model.field_space.backward(tempbc) if tempbc is not None else None
+        for varname, attr in _VARS:
+            space = getattr(model, f"{attr}_space")
+            datasets += _field_host_datasets(
+                varname, space, getattr(model.state, attr), phys[attr], xs, dxs
+            )
+        if tempbc is not None:
+            datasets += _field_host_datasets(
+                "tempbc", model.field_space, tempbc, phys_bc, xs, dxs
+            )
+    datasets.append(("time", np.asarray(float(model.time), dtype=np.float64), "raw"))
+    for key, value in model.params.items():
+        datasets.append((key, np.asarray(float(value), dtype=np.float64), "raw"))
+    return HostSnapshot(
+        datasets=datasets, step=step, time=float(model.time), dt=float(model.dt)
+    )
+
+
+def ensemble_snapshot_to_host(ens, step: int | None = None) -> HostSnapshot:
+    """Ensemble analogue of :func:`snapshot_to_host`: per-member groups plus
+    the root-level bookkeeping (``time``/``members``/``alive``/
+    ``steps_done``/params), all fetched to host in one pass."""
+    model = ens.model
+    xs, dxs = _model_coords(model)
+    datasets: list = []
+    with model._scope():
+        phys = {
+            attr: [
+                getattr(model, f"{attr}_space").backward(
+                    getattr(ens.state, attr)[i]
+                )
+                for i in range(ens.k)
+            ]
+            for _, attr in _VARS
+        }
+        tempbc = getattr(model, "tempbc_ortho", None)
+        phys_bc = model.field_space.backward(tempbc) if tempbc is not None else None
+        for i in range(ens.k):
+            for varname, attr in _VARS:
+                space = getattr(model, f"{attr}_space")
+                datasets += _field_host_datasets(
+                    f"member{i}/{varname}",
+                    space,
+                    getattr(ens.state, attr)[i],
+                    phys[attr][i],
+                    xs,
+                    dxs,
+                )
+        if tempbc is not None:
+            datasets += _field_host_datasets(
+                "tempbc", model.field_space, tempbc, phys_bc, xs, dxs
+            )
+        alive = np.asarray(ens.mask).astype(np.int8)
+        steps_done = np.asarray(ens.steps_done, dtype=np.int64)
+    datasets.append(("time", np.asarray(float(ens.time), dtype=np.float64), "raw"))
+    datasets.append(("members", np.asarray(int(ens.k), dtype=np.int64), "raw"))
+    datasets.append(("alive", alive, "raw"))
+    datasets.append(("steps_done", steps_done, "raw"))
+    for key, value in model.params.items():
+        datasets.append((key, np.asarray(float(value), dtype=np.float64), "raw"))
+    return HostSnapshot(
+        datasets=datasets, step=step, time=float(ens.time), dt=float(ens.dt)
+    )
+
+
+def write_host_snapshot(snap: HostSnapshot, filename: str) -> None:
+    """Serialize a :class:`HostSnapshot`: atomic, digest-stamped (from the
+    in-memory arrays — no read-back pass), layout-identical to the legacy
+    in-place writers.  Pure host-side work — safe on a background thread."""
+
+    def body(h5):
+        for path, data, kind in snap.datasets:
+            gpath, _, name = path.rpartition("/")
+            grp = h5.require_group(gpath) if gpath else h5
+            if kind == "field":
+                _write_array(grp, name, data)
+            else:
+                if name in grp:
+                    del grp[name]
+                grp.create_dataset(name, data=data)
+
+    _atomic_h5_write(
+        filename,
+        body,
+        step=snap.step,
+        time=snap.time,
+        dt=snap.dt,
+        digest_items=snap.datasets,
+    )
+
+
 def write_snapshot(model, filename: str, step: int | None = None) -> None:
     """Write a flow snapshot (/root/reference/src/navier_stokes/navier_io.rs:44-62).
 
     Atomic (tmp + fsync + ``os.replace``) and digest-stamped; ``step`` is an
-    optional run-step counter recorded as a root attr for resume logic."""
-
-    xs, dxs = _model_coords(model)
-
-    def body(h5):
-        for varname, attr in _VARS:
-            space = getattr(model, f"{attr}_space")
-            write_field(h5, varname, space, getattr(model.state, attr), xs, dxs)
-        if getattr(model, "tempbc_ortho", None) is not None:
-            write_field(h5, "tempbc", model.field_space, model.tempbc_ortho, xs, dxs)
-        h5.create_dataset("time", data=float(model.time))
-        for key, value in model.params.items():
-            h5.create_dataset(key, data=float(value))
-
-    _atomic_h5_write(
-        filename, body, step=step, time=float(model.time), dt=float(model.dt)
-    )
+    optional run-step counter recorded as a root attr for resume logic.
+    Implemented as fetch-then-serialize (:func:`snapshot_to_host` +
+    :func:`write_host_snapshot`) so the synchronous and background-writer
+    paths are ONE code path producing bit-identical files."""
+    write_host_snapshot(snapshot_to_host(model, step=step), filename)
 
 
 def write_ensemble_snapshot(ens, filename: str, step: int | None = None) -> None:
@@ -429,30 +614,7 @@ def write_ensemble_snapshot(ens, filename: str, step: int | None = None) -> None
     ``alive`` mask and ``steps_done`` counters, physics params, and the
     shared ``tempbc`` lift field (written once, members share it).  Atomic
     and digest-stamped like :func:`write_snapshot`."""
-
-    model = ens.model
-    xs, dxs = _model_coords(model)
-
-    def body(h5):
-        for i in range(ens.k):
-            grp = h5.require_group(f"member{i}")
-            for varname, attr in _VARS:
-                space = getattr(model, f"{attr}_space")
-                write_field(grp, varname, space, getattr(ens.state, attr)[i], xs, dxs)
-        if getattr(model, "tempbc_ortho", None) is not None:
-            write_field(h5, "tempbc", model.field_space, model.tempbc_ortho, xs, dxs)
-        h5.create_dataset("time", data=float(ens.time))
-        h5.create_dataset("members", data=int(ens.k))
-        h5.create_dataset("alive", data=np.asarray(ens.mask).astype(np.int8))
-        h5.create_dataset(
-            "steps_done", data=np.asarray(ens.steps_done, dtype=np.int64)
-        )
-        for key, value in model.params.items():
-            h5.create_dataset(key, data=float(value))
-
-    _atomic_h5_write(
-        filename, body, step=step, time=float(ens.time), dt=float(ens.dt)
-    )
+    write_host_snapshot(ensemble_snapshot_to_host(ens, step=step), filename)
 
 
 def read_ensemble_snapshot(ens, filename: str) -> None:

@@ -246,9 +246,23 @@ class StabilityGovernor:
     # -- the control law -----------------------------------------------------
 
     def on_chunk(self, status: ChunkStatus, step: int = 0) -> GovernorDecision:
-        """Decide what to do about one chunk's sentinel record."""
+        """Decide what to do about one chunk's sentinel record.
+
+        **lag=1 contract** (overlapped dispatch, utils/io_pipeline.py): the
+        status may describe a chunk that was already in flight when the
+        previous decision's dt landed, so its CFL was observed at its OWN
+        ``status.dt``, not the current rung's.  CFL is linear in dt — the
+        thresholds below act on the observation rescaled to the current
+        rung dt, otherwise a just-shrunk dt would be shrunk twice for the
+        same cause (and a stale larger-dt chunk would block regrowth).  At
+        lag 0 (``status.dt`` equals the rung dt — every synchronous run)
+        the rescale is exactly 1 and the control law is unchanged."""
         cfg, ladder = self.cfg, self.ladder
         self._record(status)
+        cfl_now = status.cfl_max
+        cur_dt = ladder.dt(self.rung)
+        if status.dt > 0.0 and status.dt != cur_dt and math.isfinite(cfl_now):
+            cfl_now = cfl_now * (cur_dt / status.dt)
 
         if not status.finite:
             # genuine NaN divergence: not the governor's event — the reactive
@@ -276,7 +290,7 @@ class StabilityGovernor:
                     f"{cfg.member_pin_patience}x despite dt drops",
                 )
             if self.rung > ladder.bottom:
-                down = ladder.rungs_to_target(status.cfl_max, cfg.target_cfl)
+                down = ladder.rungs_to_target(cfl_now, cfg.target_cfl)
                 self.rung = ladder.clamp(self.rung - down)
                 new_dt = ladder.dt(self.rung)
                 self._note_dt(step, new_dt)
@@ -296,7 +310,7 @@ class StabilityGovernor:
         # committed chunk
         self.health.steps += status.steps_done
         self._member_pins.clear()
-        cfl = status.cfl_max
+        cfl = cfl_now
         if math.isfinite(cfl) and cfl > self.shrink_cfl and self.rung > ladder.bottom:
             down = ladder.rungs_to_target(cfl, cfg.target_cfl)
             self.rung = ladder.clamp(self.rung - down)

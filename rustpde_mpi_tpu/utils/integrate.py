@@ -64,6 +64,7 @@ def integrate(
     *,
     dispatch=None,
     on_chunk=None,
+    overlap: bool | None = None,
 ) -> str:
     """Advance ``pde`` until ``max_time``; invoke ``pde.callback()`` whenever
     the time lands inside a half-dt window around a save interval.  Returns
@@ -79,9 +80,21 @@ def integrate(
     :class:`~rustpde_mpi_tpu.models.ensemble.NavierEnsemble` freezes
     individual diverged members inside its chunked step (per-member finite
     mask) and its ``exit()`` fires only once EVERY member is dead, so the
-    loop keeps advancing the surviving members."""
+    loop keeps advancing the surviving members.
+
+    ``overlap`` (chunked path only) opts into **dispatch double-buffering**:
+    the per-boundary break check rides an ``exit_future`` instead of a
+    blocking ``pde.exit()``, so the next chunk is enqueued before the
+    previous one's break flag is fetched — the host never fences the device
+    queue at a boundary.  Divergence is then detected at most ONE chunk
+    late (the in-scan early-exit has already frozen the state, so the extra
+    chunk is near-free identity work), and the final state is always
+    resolved exactly before a ``"time_limit"`` return.  ``None`` defers to
+    the model's ``io_overlap`` attribute."""
     if hasattr(pde, "update_n"):
-        return _integrate_chunked(pde, max_time, save_intervall, dispatch, on_chunk)
+        return _integrate_chunked(
+            pde, max_time, save_intervall, dispatch, on_chunk, overlap
+        )
     timestep = 0
     eps_dt = pde.get_dt() * 1e-4
     boundary = None
@@ -118,13 +131,45 @@ def integrate(
 
 
 def _integrate_chunked(
-    pde, max_time: float, save_intervall: float | None, dispatch=None, on_chunk=None
+    pde,
+    max_time: float,
+    save_intervall: float | None,
+    dispatch=None,
+    on_chunk=None,
+    overlap: bool | None = None,
 ) -> str:
     """Chunked driver: one ``update_n`` dispatch per save interval.
 
     Each chunk aims at the next *absolute* save boundary (k * save_intervall)
     so callback times never drift, and the callback only fires when the time
-    actually lands in the reference's half-dt save window."""
+    actually lands in the reference's half-dt save window.
+
+    With ``overlap`` the break check is double-buffered (see
+    :func:`integrate`): each boundary enqueues a fresh ``exit_future`` and
+    blocks — if at all — only on the PREVIOUS boundary's future, whose
+    device work was queued ahead of the chunk just dispatched and is
+    therefore already complete.  NaN persistence makes the one-chunk lag
+    safe: a frozen-NaN state (or an all-dead ensemble, or a latched
+    sentinel catch) still reads as a break at the next boundary."""
+    if overlap is None:
+        overlap = bool(getattr(pde, "io_overlap", False))
+    overlap = overlap and hasattr(pde, "exit_future")
+    pending = None  # the previous boundary's unresolved exit_future
+    dispatched = False  # any chunk run (guards the final exact resolve)
+
+    def break_hit() -> bool:
+        """Overlapped break check: resolves the newest future when it is
+        already done (latch/fast device — exact, zero lag), else trades
+        exactness for overlap by resolving the previous boundary's."""
+        nonlocal pending
+        fut = pde.exit_future()
+        if fut.ready():
+            pending = None
+            return bool(fut.result())
+        hit = bool(pending.result()) if pending is not None else False
+        pending = fut
+        return hit
+
     timestep = 0
     while True:
         # re-read dt every chunk: a supervising on_chunk/retry harness may
@@ -147,6 +192,7 @@ def _integrate_chunked(
         else:
             pde.update_n(n)
         timestep += n
+        dispatched = True
         if boundary is not None:
             # the chunk aimed at one absolute boundary; fire the callback
             # only when the time actually landed in its half-dt window (a
@@ -157,12 +203,17 @@ def _integrate_chunked(
         if timestep >= MAX_TIMESTEP:
             print(f"timestep limit reached: {timestep}")
             return "timestep_limit"
-        if pde.exit():
+        if break_hit() if overlap else pde.exit():
             print("break criteria triggered")
             return "break"
         if pde.get_time() + eps_dt >= max_time:
             break  # completed: the time limit beats a late stop request
         if on_chunk is not None and on_chunk(pde):
             return "stopped"
+    if overlap and dispatched and bool(pde.exit_future().result()):
+        # the FINAL state must be judged exactly: a NaN arriving in the last
+        # chunk still reports "break", matching the blocking driver
+        print("break criteria triggered")
+        return "break"
     print(f"time limit reached: {pde.get_time()}")
     return "time_limit"

@@ -29,6 +29,16 @@ module adds the production-harness layer on top of the ``integrate`` driver:
   (:func:`call_with_watchdog`); expiry dumps all-thread stacks via
   ``faulthandler`` and raises a structured :class:`DispatchHang` instead of
   wedging the job silently (the failure mode that ate PR 1's tier-1 budget),
+* **an overlapped I/O pipeline** — with the default
+  :class:`~rustpde_mpi_tpu.config.IOConfig`, cadence checkpoints are
+  fetched to host on the main thread and serialized/hashed/fsynced on a
+  background worker, break checks and callback diagnostics ride observable
+  futures one chunk behind the device, and dispatches are no longer fenced
+  per chunk (``block_until_ready`` only runs when a watchdog deadline needs
+  it) — so at a given cadence the device steps through checkpoint writes
+  instead of idling behind them (utils/io_pipeline.py; the writer drains
+  before every rollback/resume read and at run end, so durability and
+  recovery semantics are unchanged),
 * **a JSONL run journal** — every checkpoint, fault, retry and outcome is an
   appended JSON line (step, time, Nu, wall seconds, attempt), so a campaign's
   failure history is machine-readable after the fact,
@@ -59,6 +69,7 @@ import numpy as np
 from . import checkpoint
 from .governor import StabilityGovernor
 from .integrate import integrate
+from .io_pipeline import IOPipeline
 
 
 class DispatchHang(RuntimeError):
@@ -118,6 +129,19 @@ def call_with_watchdog(fn, timeout_s: float | None, label: str = "dispatch"):
     if error:
         raise error[0]
     return result[0]
+
+
+def _single_process() -> bool:
+    """True when the JAX runtime is (or defaults to) one process.  The
+    blanket except treats an unimportable/uninitialized runtime as single —
+    the caller then takes the local (non-collective) path, which is the
+    only one that can work without a runtime."""
+    try:
+        import jax
+
+        return jax.process_count() == 1
+    except Exception:
+        return True
 
 
 @dataclasses.dataclass
@@ -234,6 +258,7 @@ class ResilientRunner:
         resume: bool = True,
         max_chunk_steps: int = 1024,
         stability=None,
+        io=None,
     ):
         self.pde = pde
         self.max_time = float(max_time)
@@ -273,6 +298,17 @@ class ResilientRunner:
         )
         self.governor: StabilityGovernor | None = None
         self._dt0 = float(pde.get_dt())  # governor ladder anchor (pre-resume)
+        # overlapped-IO pipeline (utils/io_pipeline.py): defaults ON —
+        # async cadence checkpoints + dispatch double-buffering; multihost
+        # meshes force the checkpoint path back to the collective sync form
+        from ..config import IOConfig
+
+        self.io = io if io is not None else IOConfig()
+        self._io: IOPipeline | None = None
+        self._async_ckpt = False
+        self._overlap = False
+        self._io_snapshot_s = 0.0  # main-thread seconds staging host snapshots
+        self._lock = threading.Lock()  # journal appends + ckpt-path updates
         self.journal_path = os.path.join(run_dir, "journal.jsonl")
 
         self.step = 0  # global step counter (survives resume via ckpt attrs)
@@ -303,7 +339,12 @@ class ResilientRunner:
     # -- journal -------------------------------------------------------------
 
     def _journal(self, event: dict) -> None:
-        """Append one JSON line to ``<run_dir>/journal.jsonl`` (root only)."""
+        """Append one JSON line to ``<run_dir>/journal.jsonl`` (root only).
+
+        Thread-safe: async checkpoint completions journal from the pipeline
+        worker — the lock keeps lines whole, and events carrying their own
+        ``step``/``time`` (captured at submit) override the defaults, so a
+        write that lands mid-chunk is stamped with the step it snapshot."""
         if not _is_root():
             return
         record = {
@@ -314,9 +355,10 @@ class ResilientRunner:
             **event,
         }
         try:
-            os.makedirs(self.run_dir, exist_ok=True)
-            with open(self.journal_path, "a", encoding="utf-8") as fh:
-                fh.write(json.dumps(record) + "\n")
+            with self._lock:
+                os.makedirs(self.run_dir, exist_ok=True)
+                with open(self.journal_path, "a", encoding="utf-8") as fh:
+                    fh.write(json.dumps(record) + "\n")
         except OSError as exc:  # journaling must never kill the run
             print(f"unable to append journal {self.journal_path}: {exc}")
 
@@ -389,6 +431,13 @@ class ResilientRunner:
     def _checkpoint(self, reason: str) -> str | None:
         """Write a rolling checkpoint (root only) and barrier all hosts.
 
+        Single-process runs with ``io.async_checkpoints`` take the
+        overlapped path (:meth:`_checkpoint_async`): state fetched to host
+        here, serialization/digest/fsync on the pipeline worker.  Edge
+        checkpoints (anchor/final/preempt) drain immediately after
+        submitting, so their durability and journal ordering match the
+        synchronous writer; only cadence checkpoints overlap stepping.
+
         NOTE multi-controller limitation: the writers fetch the full state
         via ``np.asarray``, which requires every shard to be addressable
         from the root process — true on single-controller meshes (incl. the
@@ -401,6 +450,12 @@ class ResilientRunner:
             self._journal({"event": "checkpoint_skipped", "reason": reason})
             return None
         path = checkpoint.checkpoint_path(self.run_dir, self.step)
+        if self._async_ckpt and self._io is not None:
+            return self._checkpoint_async(path, reason)
+        if self._io is not None:
+            # a queued background write may still be in flight: settle the
+            # directory before this synchronous write + rotation
+            self._io.writer.drain()
         t0 = _time.monotonic()
         write_error = None
         if _is_root():
@@ -443,6 +498,62 @@ class ResilientRunner:
         )
         return path
 
+    def _checkpoint_async(self, path: str, reason: str) -> str:
+        """Overlapped checkpoint: the device sync (host snapshot fetch) and
+        the Nu readout happen here, on the boundary state the run needed
+        anyway; the expensive part — h5 serialization, the content digest,
+        two fsyncs, rotation — runs on the io_pipeline worker while the
+        device steps on.  ``_last_ckpt_path`` only advances once the write
+        is durably on disk (worker side), and every rollback/resume read
+        drains the writer first, so recovery can never target a file that
+        is still being written."""
+        t0 = _time.monotonic()
+        if self._is_ensemble:
+            snap = checkpoint.ensemble_snapshot_to_host(self.pde, step=self.step)
+        else:
+            snap = checkpoint.snapshot_to_host(self.pde, step=self.step)
+        snapshot_s = _time.monotonic() - t0
+        self._io_snapshot_s += snapshot_s
+        event = {
+            "event": "checkpoint",
+            "reason": reason,
+            "path": path,
+            "async": True,
+            "step": self.step,
+            "time": round(float(self.pde.get_time()), 9),
+            "snapshot_s": round(snapshot_s, 3),
+            "nu": self._nu(),
+        }
+
+        def work():
+            w0 = _time.monotonic()
+            try:
+                checkpoint.write_host_snapshot(snap, path)
+                checkpoint.rotate_checkpoints(self.run_dir, self.keep)
+            except BaseException as exc:
+                self._journal(
+                    {
+                        "event": "checkpoint_failed",
+                        "reason": reason,
+                        "error": str(exc),
+                        "step": event["step"],
+                    }
+                )
+                raise
+            with self._lock:
+                self._last_ckpt_path = path
+            self._journal({**event, "write_s": round(_time.monotonic() - w0, 3)})
+
+        self._io.submit_write(work, path, nbytes=snap.nbytes)
+        # cadence clocks restart at SUBMIT time: the snapshot point is what
+        # bounds data loss, not when the bytes landed
+        self._last_ckpt_wall = _time.monotonic()
+        self._last_ckpt_time = float(self.pde.get_time())
+        if reason != "cadence":
+            # anchor/final/preempt must be durable before the run proceeds
+            self._io.writer.drain()
+        return path
+
     def _pick_checkpoint(self) -> str | None:
         """Newest valid checkpoint, chosen by ROOT and broadcast: each host
         scanning its own view of run_dir could disagree (filesystem
@@ -451,14 +562,12 @@ class ResilientRunner:
         next collective.  The broadcast carries the step number — the
         step-encoded filename is the cross-host contract (multihost
         resume/rollback requires run_dir on shared storage)."""
-        single = True
-        try:
-            import jax
-
-            single = jax.process_count() == 1
-        except Exception:
-            pass
-        if single:
+        if self._io is not None:
+            # never read/scan past an in-flight background write: rollback
+            # and resume must see a settled directory (a failed write
+            # re-raises here, where the caller can still decide)
+            self._io.writer.drain()
+        if _single_process():
             return checkpoint.latest_checkpoint(self.run_dir)
         from ..parallel import multihost
 
@@ -521,13 +630,17 @@ class ResilientRunner:
                 result = None
                 for _ in range(n):
                     pde.update()
-            # force the device work into the deadline window: update_n
-            # dispatches asynchronously, the hang materializes at the sync
-            state = getattr(pde, "state", None)
-            if state is not None:
-                import jax
+            # force the device work into the deadline window ONLY when a
+            # watchdog is armed: update_n dispatches asynchronously and the
+            # hang materializes at the sync — but an unconditional fence
+            # here would serialize the overlapped pipeline (the whole point
+            # of dispatch double-buffering is to keep the queue full)
+            if self.dispatch_timeout_s:
+                state = getattr(pde, "state", None)
+                if state is not None:
+                    import jax
 
-                jax.block_until_ready(state)
+                    jax.block_until_ready(state)
             return result
 
         return call_with_watchdog(
@@ -549,6 +662,12 @@ class ResilientRunner:
         applied and the loop returns (the driver re-plans at the new dt and
         the same sim-time — that IS the retry)."""
         cap = self.max_chunk_steps if self.max_chunk_steps > 0 else n
+        if (
+            self._overlap
+            and self.governor is not None
+            and hasattr(pde, "update_n_pending")
+        ):
+            return self._advance_lagged(pde, n, cap)
         while n > 0:
             k = min(n, cap)
             dt_before = pde.get_dt()
@@ -572,6 +691,92 @@ class ResilientRunner:
                 n -= k
             if n > 0 and self._root_decides(self._interrupt is not None):
                 return  # integrate()'s on_chunk acts at the boundary
+
+    def _advance_lagged(self, pde, n: int, cap: int) -> None:
+        """Governed sub-chunking with dispatch double-buffering — the lag=1
+        sentinel contract: sub-chunk i+1 is dispatched, from chunk i's
+        PROVISIONAL end state, before chunk i's sentinel scalars are
+        fetched, so the device queue stays full while the governor reads
+        chunk i.  Exactness is preserved by construction:
+
+        * the hard CFL ceiling lives ON DEVICE (the in-scan early exit), so
+          when chunk i trips, the speculative chunk steps a finite state
+          whose work is simply discarded — ``resolve()`` of chunk i
+          restores the chunk-i start snapshot, and the in-flight pending
+          is ``discard()``-ed unresolved,
+        * a dt adjustment decided from chunk i lands after chunk i+1 was
+          dispatched at the old dt: that chunk is valid physics and is
+          committed — the governor rescales its stale-dt CFL
+          (StabilityGovernor.on_chunk) — and control returns to the driver
+          to re-plan at the new dt.
+
+        ``self.step`` counts only resolved-and-committed chunks, so
+        checkpoint filenames, journal stamps and fault-injection points are
+        identical to the synchronous path."""
+        pending: tuple | None = None  # (PendingChunkStatus, k) — one in flight
+        while n > 0 or pending is not None:
+            nxt = None
+            if n > 0:
+                k = min(n, cap)
+                nxt = (self._update_pending(pde, k), k)
+                n -= k
+            if pending is not None:
+                chunk, kprev = pending
+                dt_before = pde.get_dt()
+                status = self._resolve_pending(chunk, kprev)
+                committed = self._govern(pde, status)
+                if committed:
+                    self.step += kprev
+                if not committed:
+                    # chunk kprev rolled back in memory (retry/kill/giveup):
+                    # the speculative chunk stepped a doomed state — drop it
+                    # unresolved and let the driver re-plan
+                    if nxt is not None:
+                        nxt[0].discard()
+                    return
+                if pde.get_dt() != dt_before:
+                    # dt adjusted: settle the in-flight old-dt chunk (valid
+                    # physics; the governor rescales its stale-dt CFL), then
+                    # hand back so the driver re-plans at the new dt
+                    if nxt is not None:
+                        chunk2, k2 = nxt
+                        status2 = self._resolve_pending(chunk2, k2)
+                        if self._govern(pde, status2):
+                            self.step += k2
+                    return
+            pending = nxt
+            if (
+                pending is not None
+                and n > 0
+                and self._root_decides(self._interrupt is not None)
+            ):
+                n = 0  # interrupt: settle the in-flight chunk, then return
+
+    def _update_pending(self, pde, k: int):
+        """Watchdog-guarded DISPATCH of one deferred-commit sentinel chunk
+        (enqueue only — the matching sync point is :meth:`_resolve_pending`,
+        which carries its own watchdog)."""
+
+        def work():
+            if self._slow_pending:
+                self._slow_pending = False
+                _time.sleep(max(2.0 * (self.dispatch_timeout_s or 0.0), 1.0))
+            return pde.update_n_pending(k)
+
+        return call_with_watchdog(
+            work,
+            self.dispatch_timeout_s,
+            label=f"update_n_pending({k}) @ step {self.step}",
+        )
+
+    def _resolve_pending(self, chunk, k: int):
+        """Watchdog-guarded resolve: a wedged device materializes here, at
+        the sentinel fetch, instead of at the dispatch."""
+        return call_with_watchdog(
+            chunk.resolve,
+            self.dispatch_timeout_s,
+            label=f"resolve({k}) @ step {self.step}",
+        )
 
     def _govern(self, pde, status) -> bool:
         """Feed one chunk's sentinel status through the governor and apply
@@ -797,6 +1002,7 @@ class ResilientRunner:
                 "drop resume=False"
             )
         self._install_signals()
+        self._setup_io()
         try:
             resumed = self._maybe_resume()
             self._setup_governor()
@@ -807,6 +1013,10 @@ class ResilientRunner:
                     "dt": float(pde.get_dt()),
                     "max_time": self.max_time,
                     "governed": self.governor is not None,
+                    "io": {
+                        "async_checkpoints": self._async_ckpt,
+                        "overlap_dispatch": self._overlap,
+                    },
                     "fault": dataclasses.asdict(self.fault) if self.fault else None,
                 }
             )
@@ -823,6 +1033,7 @@ class ResilientRunner:
                         self.save_intervall,
                         dispatch=self._dispatch,
                         on_chunk=self._on_chunk,
+                        overlap=self._overlap,
                     )
                 except DispatchHang as exc:
                     self._journal(
@@ -835,11 +1046,13 @@ class ResilientRunner:
                     raise
                 if status in ("time_limit", "timestep_limit"):
                     self._checkpoint("final")
+                    self._drain_io()
                     self._journal_health()
                     self._journal({"event": "done", "status": status, "nu": self._nu()})
                     return self._summary("done")
                 if status == "stopped":
                     self._checkpoint("preempt")
+                    self._drain_io()
                     self._journal_health()
                     self._journal({"event": "preempted", "signal": self._interrupt})
                     return self._summary("preempted")
@@ -856,8 +1069,75 @@ class ResilientRunner:
                     )
                 self.attempt += 1
                 self._rollback()
+        except DispatchHang:
+            # the runtime is wedged: teardown's diag flush would fetch from
+            # the dead dispatch and block forever (un-watchdogged), eating
+            # the structured raise — drop the lagged lines instead (the
+            # background writer holds host-side data only, so its drain in
+            # _teardown_io stays safe)
+            if self._io is not None:
+                self._io.abandon_diags()
+            raise
         finally:
+            self._teardown_io()
             self._restore_signals()
+
+    def _setup_io(self) -> None:
+        """Build the overlapped-IO pipeline for this run (run() entry).
+
+        Both halves need a single-process mesh: the multihost write path is
+        collective (root-decides failure barrier), and the lagged break
+        check resolves per host on device-queue timing — one host's future
+        landing a boundary earlier than another's would desynchronize the
+        collective dispatch sequence (the same reason PR-2 made cadence
+        decisions root-broadcast).  The dispatch overlap additionally needs
+        the model to offer ``exit_future``.  The model's ``io_pipeline``
+        attribute is pointed at the run's pipeline so its callback IO (flow
+        snapshots, diagnostics lines) shares the worker and lag queue —
+        restored on exit."""
+        io = self.io
+        single = _single_process()
+        self._async_ckpt = bool(io.async_checkpoints and single)
+        self._overlap = bool(
+            io.overlap_dispatch and single and hasattr(self.pde, "exit_future")
+        )
+        self._io_snapshot_s = 0.0  # per-run, like the pipeline's own stats
+        self._saved_pde_io = getattr(self.pde, "io_pipeline", None)
+        if self._async_ckpt or self._overlap:  # implies single-process
+            self._io = IOPipeline(queue_depth=io.queue_depth, diag_lag=io.diag_lag)
+            self.pde.io_pipeline = self._io
+
+    def _drain_io(self) -> None:
+        """Flush lagged diagnostics + wait for background writes, surfacing
+        the first write failure (the normal-completion settle point), then
+        journal one ``io_overlap`` summary: payload bytes, main-thread
+        staging seconds (device fetch), worker write seconds, submitter
+        seconds lost to back-pressure, and the configured queue depth."""
+        if self._io is not None:
+            self._io.drain()
+            self._journal(
+                {
+                    "event": "io_overlap",
+                    **self._io.stats(),
+                    "snapshot_s": round(self._io_snapshot_s, 3),
+                    "queue_depth": self.io.queue_depth,
+                    "diag_lag": self.io.diag_lag,
+                }
+            )
+
+    def _teardown_io(self) -> None:
+        """run() exit: settle the pipeline WITHOUT masking an in-flight
+        exception (write failures were either surfaced at the last
+        submit/drain or remain journaled as ``checkpoint_failed``), stop
+        the worker, and give the model its previous pipeline back."""
+        if self._io is not None:
+            try:
+                self._io.drain(raise_errors=False)
+            finally:
+                self._io.close()
+        saved = getattr(self, "_saved_pde_io", None)
+        if getattr(self.pde, "io_pipeline", None) is not saved:
+            self.pde.io_pipeline = saved
 
     def _setup_governor(self) -> None:
         """Arm the sentinels + build the dt governor (run() start, after a
@@ -909,4 +1189,7 @@ class ResilientRunner:
             "health": (
                 self.governor.health.asdict() if self.governor is not None else None
             ),
+            # overlapped-IO telemetry: background writes, worker seconds,
+            # submitter seconds lost to back-pressure
+            "io": self._io.stats() if self._io is not None else None,
         }

@@ -42,21 +42,22 @@ def main() -> int:
     except subprocess.TimeoutExpired as exc:
         # a hung suite must still leave a TESTS.json entry: record the
         # timeout (rc=124, the coreutils convention) before exiting nonzero
+        out = exc.stdout
+        if isinstance(out, bytes):
+            out = out.decode(errors="replace")
         record = {
             "tier": tier,
             "summary": f"timeout: suite exceeded {timeout_s}s",
             "passed": 0,
             "failed": 0,
             "skipped": 0,
+            "dots_passed": _dots_passed(out or ""),  # how far the run got
             "wall_s": round(time.time() - t0, 1),
             "returncode": 124,
             "date": _utc_now(),
         }
         _persist(record)
         print(json.dumps(record))
-        out = exc.stdout
-        if isinstance(out, bytes):
-            out = out.decode(errors="replace")
         sys.stderr.write((out or "")[-4000:])
         return 124
     wall = time.time() - t0
@@ -72,6 +73,12 @@ def main() -> int:
         "passed": counts.get("passed", 0),
         "failed": counts.get("failed", 0) + counts.get("error", 0),
         "skipped": counts.get("skipped", 0),
+        # the tier-1 driver's own progress metric (ROADMAP "Tier-1 verify"
+        # counts '.' chars on the -q progress lines as DOTS_PASSED): record
+        # it per run so an IO/test-duration regression that changes how far
+        # the suite gets is visible across PRs even when the summary line
+        # is missing (hang/kill)
+        "dots_passed": _dots_passed(proc.stdout or ""),
         "wall_s": round(wall, 1),
         "returncode": proc.returncode,
         "date": _utc_now(),
@@ -81,6 +88,18 @@ def main() -> int:
     if proc.returncode != 0:
         sys.stderr.write(proc.stdout[-4000:])
     return proc.returncode
+
+
+def _dots_passed(out: str) -> int:
+    """Count pass-dots on pytest -q progress lines — the same
+    ``^[.FEsx]+( *\\[ *[0-9]+%\\])?$`` line shape (and dot count) the
+    ROADMAP tier-1 verify greps as DOTS_PASSED."""
+    progress = re.compile(r"^[.FEsx]+( *\[ *[0-9]+%\])?$")
+    return sum(
+        line.count(".")
+        for line in out.splitlines()
+        if progress.match(line.strip())
+    )
 
 
 def _utc_now() -> str:

@@ -31,6 +31,7 @@ _CASES = {
         "--quick", "--max-time", "0.5", "--fault", "spike@8",
         "--spike-factor", "100", "--grow-after", "2",
     ],
+    "navier_rbc_pipelined.py": ["--quick", "--max-time", "0.2"],
     "navier_rbc_roughness.py": ["--quick"],
     "navier_mpi.py": ["--quick"],
     "navier_rbc_steady.py": ["--quick"],

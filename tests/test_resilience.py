@@ -237,6 +237,7 @@ def test_nan_rollback_dt_backoff_matches_clean_run(tmp_path):
         "divergence",
         "retry",
         "checkpoint",  # final
+        "io_overlap",  # run-end pipeline summary (async IO is the default)
         "done",
     ]
     retry = next(e for e in _events(run_dir) if e["event"] == "retry")
