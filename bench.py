@@ -13,7 +13,7 @@ Environment knobs:
                                   poisson1025_f64, rbc1025, rbc1025_f64,
                                   sh2048, rbc2049, rbc2049_f64, rbc129_f64,
                                   ensemble129, resilience129, governor129,
-                                  pipeline129
+                                  pipeline129, shardedio129
     RUSTPDE_BENCH_STEPS    timed window for the primary config (default 64;
                            rates are slope-timed over windows L and 4L, see
                            utils/profiling.benchmark_steps)
@@ -70,6 +70,7 @@ DEFAULT_CONFIGS = [
     "resilience129",
     "governor129",
     "pipeline129",
+    "shardedio129",
     "periodic",
     "poisson1025",
     "poisson1025_f64",
@@ -94,6 +95,7 @@ METRIC_NAMES = {
     "resilience129": "2D RBC confined 129x129 Ra=1e7 NaN-fault recovery",
     "governor129": "2D RBC confined 129x129 Ra=1e7 stability governor (sentinel overhead + spike catch)",
     "pipeline129": "2D RBC confined 129x129 Ra=1e7 overlapped I/O pipeline (async checkpoints + dispatch double-buffering)",
+    "shardedio129": "2D RBC sharded two-phase checkpoints, 2-proc CPU harness (sharded vs gathered write + elastic-restore gate)",
     "periodic": "2D RBC periodic 128x65 Ra=1e6",
     "periodic1024": "2D RBC periodic 1024x1025 Ra=1e9",
     "poisson1025": "Poisson standalone 1025x1025",
@@ -519,6 +521,111 @@ def bench_pipeline(nx, ny, ra, dt, steps):
     }
 
 
+def bench_sharded_io(reps=3):
+    """Sharded-vs-gathered checkpoint IO on the 2-process CPU harness
+    (tests/mp_worker.py ``bench_sharded`` mode): a real 2-controller
+    ``jax.distributed`` cluster writes the same state both ways —
+
+    * **sharded**: the distributed two-phase writer (per-host shard files +
+      digest allgather + root manifest commit, utils/checkpoint),
+    * **gathered**: the pre-sharded multihost shape — allgather every state
+      leaf to every host, root serializes the full state.
+
+    Reported: min wall seconds per write for both legs, bytes/host vs total
+    bytes, and the commit barrier wait.  The red/green gate is durability,
+    not speed (on one box both legs share the same disk): the final
+    manifest must verify END-TO-END (manifest digest + every shard digest)
+    and a cross-topology restore — the 2-process 4-device checkpoint read
+    back into a SERIAL model — must be bit-equal to the workers' dumped
+    global state.  Runs on CPU subprocesses regardless of the bench
+    platform (the harness exists to prove the protocol, not the chip)."""
+    import shutil
+    import subprocess
+    import tempfile
+
+    sys.path.insert(0, os.path.join(_REPO, "tests"))
+    from mp_harness import spawn_cluster  # ONE spawn recipe, shared with CI
+
+    out_dir = tempfile.mkdtemp(prefix="bench_shardedio_")
+    try:
+        outs = spawn_cluster(
+            out_dir, mode="bench_sharded", timeout=900, check=False
+        )
+        if outs is None:
+            raise RuntimeError("bench_sharded cluster spawn timed out")
+        for rc, out, err in outs:
+            if rc != 0:
+                raise RuntimeError(f"bench_sharded worker failed:\n{err[-2000:]}")
+        env = dict(
+            os.environ,
+            JAX_PLATFORMS="cpu",
+            XLA_FLAGS="--xla_force_host_platform_device_count=2",
+            RUSTPDE_X64="1",
+        )
+        with open(os.path.join(out_dir, "result.json")) as f:
+            r = json.load(f)
+
+        # durability + cross-topology restore gate, in a clean CPU process
+        verifier = r"""
+import json, sys
+import numpy as np
+import jax
+jax.config.update("jax_platforms", "cpu")
+from rustpde_mpi_tpu import Navier2D
+from rustpde_mpi_tpu.utils import checkpoint as cp
+
+manifest, npz, nx = sys.argv[1], sys.argv[2], int(sys.argv[3])
+attrs = cp.verify_snapshot(manifest)          # manifest + all shard digests
+model = Navier2D(nx, nx, 1e4, 1.0, 2e-3, 1.0, "rbc", periodic=False)
+model.read(manifest)                          # elastic: 2-proc mesh -> serial
+dumped = np.load(npz)
+equal = all(
+    np.array_equal(np.asarray(getattr(model.state, name)), dumped[name])
+    for name in model.state._fields
+)
+print(json.dumps({"verify_ok": True, "restore_equal": bool(equal),
+                  "sharded": int(attrs["sharded"])}))
+"""
+        out = subprocess.run(
+            [
+                sys.executable,
+                "-c",
+                verifier,
+                r["manifest"],
+                os.path.join(out_dir, "final_state.npz"),
+                str(r["grid"][0]),
+            ],
+            capture_output=True,
+            text=True,
+            env=env,
+            timeout=600,
+            cwd=_REPO,
+        )
+        if out.returncode != 0:
+            raise RuntimeError(f"sharded verify/restore failed:\n{out.stderr[-2000:]}")
+        gate = json.loads(out.stdout.strip().splitlines()[-1])
+        ok = bool(gate["verify_ok"] and gate["restore_equal"])
+        return {
+            # headline rate: sharded checkpoint commits per second
+            "steps_per_sec": 1.0 / max(r["sharded_write_s"], 1e-9),
+            "unit_note": "steps_per_sec = sharded two-phase commits/s (2-proc CPU)",
+            "sharded_write_s": r["sharded_write_s"],
+            "gathered_write_s": r["gathered_write_s"],
+            "sharded_vs_gathered_x": r["gathered_write_s"] / r["sharded_write_s"],
+            "bytes_host": r["bytes_host"],
+            "bytes_total": r["bytes_total"],
+            "shards": r["shards"],
+            "barrier_s": r["barrier_s"],
+            "grid": r["grid"],
+            "nproc": r["nproc"],
+            "manifest_verify_ok": gate["verify_ok"],
+            "cross_topology_restore_equal": gate["restore_equal"],
+            "finite": ok,
+        }
+    finally:
+        shutil.rmtree(out_dir, ignore_errors=True)
+
+
 def bench_resilience(nx, ny, ra, dt, steps):
     """Recovery-overhead config (utils/resilience.py): the same horizon run
     twice — once clean (plain ``integrate``), once under a
@@ -893,6 +1000,9 @@ def main() -> int:
                 # two full horizons with a checkpoint every boundary; capped
                 # like resilience129 so the doubled run fits the budget
                 r = bench_pipeline(129, 129, 1e7, 2e-3, max(32, min(steps, 128)))
+            elif name == "shardedio129":
+                # 2-process CPU cluster (durability harness, chip-independent)
+                r = bench_sharded_io()
             elif name == "governor129":
                 # overhead leg slope-times two chains; the spike legs rerun
                 # a capped horizon (governed: at the descended-ladder dt)

@@ -63,6 +63,12 @@ def main() -> int:
         "--run-dir still holds a previous campaign's checkpoints",
     )
     ap.add_argument("--mesh", action="store_true", help="pencil-shard over all devices")
+    ap.add_argument(
+        "--sharded", action="store_true",
+        help="force the distributed two-phase checkpoint format (per-host "
+        "shard files + manifest commit marker); auto-selected on "
+        "multi-process runtimes either way",
+    )
     args = ap.parse_args()
 
     if args.quick:
@@ -81,6 +87,12 @@ def main() -> int:
 
         mesh = make_mesh()
 
+    io = None
+    if args.sharded:
+        from rustpde_mpi_tpu.config import IOConfig
+
+        io = IOConfig(sharded_checkpoints=True)
+
     model = Navier2D.new_confined(nx, ny, ra, 1.0, dt, 1.0, "rbc", mesh=mesh)
     runner = ResilientRunner(
         model,
@@ -95,6 +107,7 @@ def main() -> int:
         dispatch_timeout_s=args.dispatch_timeout_s,
         fault=args.fault,
         resume=not args.fresh,
+        io=io,
     )
     try:
         summary = runner.run()

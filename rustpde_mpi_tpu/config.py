@@ -166,17 +166,30 @@ class IOConfig:
       digest-stamped + fsynced on a background worker while the device steps
       the next chunks.  Edge checkpoints (anchor/final/preempt) stay
       effectively synchronous — the runner drains right after submitting
-      them — and multihost meshes disable the whole pipeline (async writes
-      AND dispatch overlap): the write-failure barrier must stay collective,
-      and a lagged break check resolving on per-host device timing would
-      desynchronize the collective dispatch sequence.
+      them.  On multihost meshes the WRITE side runs through per-host
+      background shard writers (each host overlaps its own shard
+      serialization; the two-phase manifest commit happens collectively at
+      the next chunk boundary, after every host drained its writer —
+      drain-before-barrier), while ``overlap_dispatch`` stays disabled:
+      a lagged break check resolving on per-host device timing would
+      desynchronize the collective dispatch sequence, so break decisions
+      remain un-lagged and root-broadcast.
       Durability is unchanged: writes are still atomic and verified, the
       writer drains before any rollback/resume read, and a write failure
-      re-raises at the next submit/drain.
+      re-raises at the next submit/drain (collectively, on the sharded
+      path: no manifest is committed when any host failed).
     * ``overlap_dispatch`` — dispatch double-buffering in the chunked
       driver: break checks + callback observables ride futures (one-chunk
       lag, see ``integrate(overlap=...)``) instead of fencing the device
-      queue every boundary.
+      queue every boundary.  Single-process only (see above).
+    * ``sharded_checkpoints`` — the distributed two-phase checkpoint format
+      (utils/checkpoint.write_sharded_snapshot: per-host shard files +
+      root manifest commit marker).  ``None`` (default) = auto: sharded on
+      multi-process runtimes, gathered single-file otherwise; ``True``
+      forces the sharded format (CI exercises it on the single-process
+      virtual mesh); ``False`` pins the legacy gathered writer (which
+      REQUIRES fully-addressable state — it cannot checkpoint a real
+      multi-controller mesh).
     * ``queue_depth`` — bounded in-flight background writes: a submission
       past the depth blocks (back-pressure), so host memory holds at most
       ``queue_depth`` pending snapshots and cadence can never outrun disk.
@@ -186,6 +199,7 @@ class IOConfig:
 
     async_checkpoints: bool = True
     overlap_dispatch: bool = True
+    sharded_checkpoints: bool | None = None
     queue_depth: int = 1
     diag_lag: int = 1
 

@@ -720,6 +720,48 @@ class NavierEnsemble(Integrate):
                 except OSError as exc:  # never fatal, like the single-run callback
                     print(f"unable to write ensemble snapshot: {exc}")
 
+    @property
+    def mesh(self):
+        """The template model's pencil mesh (None = single device) — the
+        sharded-checkpoint layer reads this to build target layouts."""
+        return self.model.mesh
+
+    # -- sharded (shard-wise) snapshot surface -------------------------------
+
+    def snapshot_state_items(self) -> list:
+        """``(name, device_array)`` per batched state leaf (leading K axis
+        rides along as replicated batch under the pencil spec) — see
+        ``Navier2D.snapshot_state_items``."""
+        return [
+            (f"state/{name}", getattr(self.state, name))
+            for name in self.state._fields
+        ]
+
+    def snapshot_root_items(self) -> list:
+        """Replicated manifest-root data: time, params AND the ensemble
+        bookkeeping (member count, alive mask, per-member step counters)."""
+        items = [("time", np.asarray(float(self.time), dtype=np.float64), "raw")]
+        items.append(("members", np.asarray(int(self.k), dtype=np.int64), "raw"))
+        items.append(("alive", np.asarray(self.mask).astype(np.int8), "raw"))
+        items.append(
+            ("steps_done", np.asarray(self.steps_done, dtype=np.int64), "raw")
+        )
+        for key, value in self.model.params.items():
+            items.append((key, np.asarray(float(value), dtype=np.float64), "raw"))
+        return items
+
+    def apply_restored_state(self, updates: dict, attrs: dict, root: dict) -> None:
+        """Install the assembled batched leaves + bookkeeping.  The sharded
+        format is exact (bit-equal restore), so the member count must match
+        — the reader rejects K mismatches before assembly (K-elastic
+        restarts go through the gathered per-member layout)."""
+        self.state = self.state._replace(**updates)
+        self.mask = jnp.asarray(np.asarray(root["alive"], dtype=bool))
+        self.steps_done = jnp.asarray(np.asarray(root["steps_done"]), jnp.int32)
+        self.time = float(np.asarray(root["time"]))
+        self._obs_cache = None
+        self._pre_div_latch = False
+
     def write(self, filename: str) -> None:
         """Write a K-member snapshot (per-member groups, utils/checkpoint)."""
         from ..utils import checkpoint

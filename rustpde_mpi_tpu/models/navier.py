@@ -1056,10 +1056,42 @@ class Navier2D(Integrate):
 
     def read(self, filename: str) -> None:
         """Restore from a snapshot (supports resolution change via spectral
-        interpolation)."""
+        interpolation; sharded-checkpoint manifests restore topology-
+        elastically, see utils/checkpoint.read_sharded_snapshot)."""
         from ..utils import checkpoint
 
         checkpoint.read_snapshot(self, filename)
+
+    # -- sharded (shard-wise) snapshot surface -------------------------------
+    # utils/checkpoint's distributed two-phase writer/reader drives these:
+    # each process fetches only its addressable shards, so checkpoints work
+    # on multi-controller meshes where np.asarray(state) cannot.
+
+    def snapshot_state_items(self) -> list:
+        """``(name, device_array)`` for every state leaf the sharded
+        checkpoint must carry — the full restart set (``pseu`` included, so
+        a restore is bit-equal to the writer's state, not merely
+        restart-equivalent)."""
+        return [
+            (f"state/{name}", getattr(self.state, name))
+            for name in self.state._fields
+        ]
+
+    def snapshot_root_items(self) -> list:
+        """Replicated host-side data for the sharded manifest root (the
+        HostSnapshot ``datasets`` tuple convention)."""
+        items = [("time", np.asarray(float(self.time), dtype=np.float64), "raw")]
+        for key, value in self.params.items():
+            items.append((key, np.asarray(float(value), dtype=np.float64), "raw"))
+        return items
+
+    def apply_restored_state(self, updates: dict, attrs: dict, root: dict) -> None:
+        """Install state leaves assembled by the sharded reader (already
+        placed in this model's target layout) + the manifest's time."""
+        self.state = self.state._replace(**updates)
+        self.time = float(np.asarray(root["time"]))
+        self._obs_cache = None
+        self._pre_div_latch = False
 
     def read_unwrap(self, filename: str) -> None:
         from ..utils.checkpoint import CheckpointError

@@ -66,16 +66,26 @@ class use_mesh:
         return False
 
 
-def sharding(spec: tuple, ndim: int | None = None) -> NamedSharding | None:
-    """NamedSharding for ``spec``; when ``ndim`` exceeds the spec rank the
-    spec applies to the *trailing* dims (leading dims are replicated batch —
-    the stacked-field transforms in models/navier.py)."""
-    mesh = active_mesh()
-    if mesh is None:
-        return None
+def pencil_sharding(mesh: Mesh, spec: tuple, ndim: int | None = None) -> NamedSharding:
+    """NamedSharding for ``spec`` on an EXPLICIT mesh.  When ``ndim``
+    exceeds the spec rank the spec applies to the *trailing* dims (leading
+    dims are replicated batch).  This is the active-mesh-free form — the
+    sharded-checkpoint restore (utils/checkpoint.read_sharded_snapshot)
+    builds target layouts for meshes that are not installed as the active
+    pencil mesh."""
     if ndim is not None and ndim > len(spec):
         spec = (None,) * (ndim - len(spec)) + tuple(spec)
     return NamedSharding(mesh, PartitionSpec(*spec))
+
+
+def sharding(spec: tuple, ndim: int | None = None) -> NamedSharding | None:
+    """NamedSharding for ``spec`` on the ACTIVE mesh; when ``ndim`` exceeds
+    the spec rank the spec applies to the *trailing* dims (leading dims are
+    replicated batch — the stacked-field transforms in models/navier.py)."""
+    mesh = active_mesh()
+    if mesh is None:
+        return None
+    return pencil_sharding(mesh, spec, ndim)
 
 
 # Small arrays whose sharded dim does not divide the mesh are PLACED fully

@@ -13,6 +13,9 @@ runs unchanged on the larger mesh.  This module is the thin glue:
 * :func:`host_local_array` / :func:`global_array` — host-slab <-> global
   array conversion for IO (the gather/scatter-to-root analog across hosts).
 * :func:`sync_hosts` — barrier.
+* :func:`allgather_host` / :func:`broadcast` — small host-value collectives
+  (the sharded-checkpoint digest exchange and the root-decides handshakes
+  in utils/resilience.py ride these).
 
 Single-host processes (including this container's one-chip tunnel and the
 virtual CPU mesh) can call everything here unchanged: initialization is a
@@ -79,6 +82,17 @@ def initialize_distributed(
             "num_processes given but no coordinator address (argument or "
             "JAX_COORDINATOR_ADDRESS)"
         )
+    # CPU clusters need an explicit cross-process collectives backend: since
+    # jax 0.4.37 a multi-process CPU computation without one dies with
+    # "Multiprocess computations aren't implemented on the CPU backend".
+    # Select gloo BEFORE backend init when the run is pinned to CPU (the
+    # 2-process test/bench harness, tests/mp_worker.py); other platforms
+    # keep their native transports (ICI/DCN).
+    if (jax.config.jax_platforms or "").split(",")[0] == "cpu":
+        try:
+            jax.config.update("jax_cpu_collectives_implementation", "gloo")
+        except Exception:
+            pass  # older jax: single-process CPU still works unchanged
     explicit = any(
         v is not None for v in (coordinator_address, num_processes, process_id)
     )
@@ -152,6 +166,19 @@ def host_local_array(arr: jax.Array, spec: tuple | None = None) -> np.ndarray:
     return multihost_utils.global_array_to_host_local_array(
         arr, arr.sharding.mesh, arr.sharding.spec
     )
+
+
+def allgather_host(value) -> np.ndarray:
+    """Allgather a small host value across processes: every host gets the
+    stacked ``(nproc, ...)`` array (rank order).  The sharded-checkpoint
+    commit uses this to exchange per-shard digests/byte counts so root can
+    write the manifest without re-reading any shard file.  Single-host:
+    the value with a length-1 leading axis."""
+    if jax.process_count() == 1:
+        return np.asarray(value)[None]
+    from jax.experimental import multihost_utils
+
+    return np.asarray(multihost_utils.process_allgather(np.asarray(value)))
 
 
 def broadcast(value, is_source: bool | None = None):

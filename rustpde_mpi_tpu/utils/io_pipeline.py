@@ -45,6 +45,14 @@ worker thread.  Device fetches happen on the submitting thread — fetching
 sharded jax Arrays from pool threads can starve the runtime's own thread
 pool (the PR-1 ``slice_io`` deadlock), so the split is fetch-on-main,
 serialize-on-worker by design.
+
+Multihost: the WRITE side runs here too — each host's shard of a
+distributed checkpoint (utils/checkpoint.ShardSnapshot) is serialized on
+that host's own writer, and the resilient runner drains the writer before
+the two-phase commit barrier (drain-before-barrier), so a manifest only
+ever names fsynced shards.  The lagged break check stays single-process:
+futures resolving on per-host device timing would desynchronize the
+collective dispatch sequence (utils/resilience._setup_io).
 """
 
 from __future__ import annotations

@@ -7,7 +7,12 @@ module adds the production-harness layer on top of the ``integrate`` driver:
 
 * **durable checkpoints** — rolling, atomic, digest-stamped snapshots
   (utils/checkpoint.py) written on a wall-clock and/or sim-time cadence,
-  with a retention window and auto-resume from the newest *valid* file,
+  with a retention window and auto-resume from the newest *valid* file;
+  on multi-process meshes (or with ``IOConfig.sharded_checkpoints``) the
+  SHARDED two-phase format is used — each host writes only its addressable
+  shards, root commits via an atomic manifest whose presence is the commit
+  marker, and restore is topology-elastic (a checkpoint written at one
+  mesh/host count resumes on another, or serially, bit-equal),
 * **preemption safety** — SIGTERM/SIGINT handlers that finish the in-flight
   chunk, checkpoint, journal and exit cleanly; on multihost meshes rank 0
   decides and the decision is broadcast so every host snapshots the same
@@ -146,22 +151,39 @@ def _single_process() -> bool:
 
 @dataclasses.dataclass
 class FaultPlan:
-    """Parsed ``RUSTPDE_FAULT`` spec: inject ``kind`` once when the run's
-    global step counter reaches ``step``.
+    """Parsed ``RUSTPDE_FAULT`` spec ``<kind>@<step>[:host<p>]``: inject
+    ``kind`` once when the run's global step counter reaches ``step``,
+    optionally scoped to ONE process of a multihost job (``host`` = process
+    index; every host still *fires* the plan at the same step so collective
+    dispatch stays aligned — only the scoped host acts).
 
     * ``nan``   — poison the state (every recovery path downstream of the
-      model's NaN break criterion),
+      model's NaN break criterion); host-scoped, only the columns owned by
+      that host's devices are poisoned (a single-host fault that then
+      propagates through the collective step, the realistic multihost
+      divergence shape),
     * ``spike`` — scale the velocity fields by ``spike_factor`` on-device:
       the state stays *finite* but its CFL number blows past the sentinel
       ceiling, so this exercises the stability governor's pre-divergence
       catch + in-memory rollback + dt-ladder descent/regrowth — and, on an
-      ungoverned run, the incipient-blow-up-to-NaN path,
-    * ``kill``  — SIGTERM this process (the preemption path),
+      ungoverned run, the incipient-blow-up-to-NaN path; host-scoped like
+      ``nan``,
+    * ``kill``  — SIGTERM this process (the preemption path).  HOST-SCOPED
+      kill is a hard ``SIGKILL`` instead: one host of a multihost job dying
+      without ceremony (the surviving hosts hit the next collective and
+      need ``RUSTPDE_SYNC_TIMEOUT_S`` to convert the wedge into a
+      structured :class:`DispatchHang`),
     * ``slow``  — stall the next dispatch past the watchdog deadline (the
-      :class:`DispatchHang` path)."""
+      :class:`DispatchHang` path); host-scoped, only that host stalls.
+
+    The two-phase checkpoint WINDOW faults (kill between shard fsync and
+    manifest commit) are a separate hook — ``RUSTPDE_SHARD_CRASH``, see
+    utils/checkpoint._shard_crash_hook — because they key on a phase of the
+    commit protocol, not a step count."""
 
     kind: str
     step: int
+    host: int | None = None
     fired: bool = False
 
     KINDS = ("nan", "spike", "kill", "slow")
@@ -170,41 +192,118 @@ class FaultPlan:
     def from_spec(cls, spec: str | None) -> "FaultPlan | None":
         if not spec:
             return None
-        kind, sep, at = spec.partition("@")
+        kind, sep, rest = spec.partition("@")
+        at, hsep, host = rest.partition(":")
         if kind not in cls.KINDS or not sep:
             raise ValueError(
-                f"bad fault spec {spec!r}: expected <nan|spike|kill|slow>@<step>"
+                f"bad fault spec {spec!r}: expected "
+                "<nan|spike|kill|slow>@<step>[:host<p>]"
             )
-        return cls(kind=kind, step=int(at))
+        if hsep and (not host.startswith("host") or not host[4:].isdigit()):
+            raise ValueError(
+                f"bad fault scope {host!r} in {spec!r}: expected host<p>"
+            )
+        return cls(
+            kind=kind,
+            step=int(at),
+            host=int(host[4:]) if hsep else None,
+        )
+
+    def scoped_here(self) -> bool:
+        """True when this process must ACT on the fault (unscoped, or the
+        scope names this process)."""
+        if self.host is None:
+            return True
+        try:
+            import jax
+
+            return int(jax.process_index()) == self.host
+        except Exception:
+            return self.host == 0
 
 
-def poison_state(pde) -> None:
+def _host_column_mask(pde, host: int, leaf, hit, miss=1.0):
+    """Per-leaf multiplier that applies ``hit`` only on the spectral
+    columns owned by process ``host``'s devices (the pencil axis is the
+    LAST one under the x-pencil SPEC layout) and ``miss`` elsewhere.
+
+    Every process builds the identical mask from the mesh metadata alone,
+    so a host-scoped fault stays a CONSISTENT collective dispatch — the
+    fault originates on one host's shard and propagates through the
+    coupled step, like a real single-host memory corruption would."""
+    import jax.numpy as jnp
+
+    from ..parallel.mesh import SPEC, pencil_sharding
+
+    mesh = getattr(pde, "mesh", None)
+    n = leaf.shape[-1]
+    # dtype from metadata only — np.asarray(leaf) would fetch the whole
+    # leaf, which raises on a real multi-controller mesh (non-addressable
+    # shards), the very platform host-scoped faults exist for
+    cols = np.full(n, miss, dtype=np.empty(0, leaf.dtype).real.dtype)
+    if mesh is None:
+        if host in (0, None):
+            cols[:] = hit
+    else:
+        s = pencil_sharding(mesh, SPEC, ndim=len(leaf.shape))
+        try:
+            imap = s.devices_indices_map(tuple(leaf.shape))
+        except ValueError:  # uneven dim: replicated layout, host 0 owns all
+            imap = None
+        if imap is None:
+            if host == 0:
+                cols[:] = hit
+        else:
+            for dev, idx in imap.items():
+                if dev.process_index != host:
+                    continue
+                start, stop, _ = idx[-1].indices(n)
+                cols[start:stop] = hit
+    return jnp.asarray(cols)
+
+
+def poison_state(pde, host: int | None = None) -> None:
     """Multiply every state leaf by NaN (the deterministic stand-in for a
-    numerical blow-up; used by fault injection)."""
+    numerical blow-up; used by fault injection).  With ``host`` given, only
+    the spectral columns owned by that process's devices are poisoned —
+    the multihost single-host-corruption shape (the NaN infects the rest
+    of the domain through the next coupled step)."""
     import jax
 
     scope = pde.model._scope if hasattr(pde, "model") else pde._scope
     with scope():
-        pde.state = jax.tree.map(lambda x: x * float("nan"), pde.state)
+        if host is None:
+            pde.state = jax.tree.map(lambda x: x * float("nan"), pde.state)
+        else:
+            mdl = pde.model if hasattr(pde, "model") else pde
+            pde.state = jax.tree.map(
+                lambda x: x * _host_column_mask(mdl, host, x, float("nan")),
+                pde.state,
+            )
         if hasattr(pde, "mask") and hasattr(pde, "_finite_mask"):
             pde.mask = pde._finite_mask(pde.state)
     pde._obs_cache = None
 
 
-def spike_state(pde, factor: float = 50.0) -> None:
+def spike_state(pde, factor: float = 50.0, host: int | None = None) -> None:
     """Scale the velocity fields by ``factor`` on-device: a deterministic
     incipient blow-up — finite state, CFL far past the stability ceiling.
     Under the governor this is caught pre-NaN (rollback happens in memory
     and dt descends the ladder until the spiked flow is Courant-stable);
     without sentinels the over-CFL explicit convection grows it into the
     NaN divergence path within a few steps.  For ensembles the spike hits
-    every member (the state leaves carry the leading K axis)."""
+    every member (the state leaves carry the leading K axis).  With
+    ``host``, only that process's spectral columns are scaled."""
     scope = pde.model._scope if hasattr(pde, "model") else pde._scope
     with scope():
         st = pde.state
-        pde.state = st._replace(
-            velx=st.velx * factor, vely=st.vely * factor
-        )
+        if host is None:
+            fx = fy = factor
+        else:
+            mdl = pde.model if hasattr(pde, "model") else pde
+            fx = _host_column_mask(mdl, host, st.velx, factor)
+            fy = _host_column_mask(mdl, host, st.vely, factor)
+        pde.state = st._replace(velx=st.velx * fx, vely=st.vely * fy)
     pde._obs_cache = None
 
 
@@ -300,13 +399,18 @@ class ResilientRunner:
         self._dt0 = float(pde.get_dt())  # governor ladder anchor (pre-resume)
         # overlapped-IO pipeline (utils/io_pipeline.py): defaults ON —
         # async cadence checkpoints + dispatch double-buffering; multihost
-        # meshes force the checkpoint path back to the collective sync form
+        # meshes keep async SHARD writes (per-host writer, commit deferred
+        # to the next boundary) but disable the lagged break check
         from ..config import IOConfig
 
         self.io = io if io is not None else IOConfig()
         self._io: IOPipeline | None = None
         self._async_ckpt = False
         self._overlap = False
+        self._sharded = False  # distributed two-phase checkpoint format
+        # one deferred sharded commit may be in flight: (snap, path, reason,
+        # journal event) — committed at the next chunk boundary
+        self._pending_commit: tuple | None = None
         self._io_snapshot_s = 0.0  # main-thread seconds staging host snapshots
         self._lock = threading.Lock()  # journal appends + ckpt-path updates
         self.journal_path = os.path.join(run_dir, "journal.jsonl")
@@ -438,18 +542,19 @@ class ResilientRunner:
         submitting, so their durability and journal ordering match the
         synchronous writer; only cadence checkpoints overlap stepping.
 
-        NOTE multi-controller limitation: the writers fetch the full state
-        via ``np.asarray``, which requires every shard to be addressable
-        from the root process — true on single-controller meshes (incl. the
-        virtual CPU mesh) but NOT on a real multi-controller pencil mesh,
-        where snapshot IO must go through the per-host slab path
-        (utils/slice_io.py; wiring that into the runner is future work).
-        A root-side write failure still reaches the barrier, so the other
-        hosts see the error as a clean raise instead of a wedged job."""
+        Multi-controller meshes (and forced ``io.sharded_checkpoints``)
+        take the SHARDED two-phase path (:meth:`_checkpoint_sharded`): each
+        process writes only its addressable shards and root commits via an
+        atomic manifest — the per-host slab IO the gathered writers (which
+        fetch the full state via ``np.asarray``) cannot provide.  A write
+        failure on ANY host aborts the commit collectively (no manifest),
+        so every host sees a clean raise instead of a wedged job."""
         if not self._state_ok():
             self._journal({"event": "checkpoint_skipped", "reason": reason})
             return None
         path = checkpoint.checkpoint_path(self.run_dir, self.step)
+        if self._sharded:
+            return self._checkpoint_sharded(path, reason)
         if self._async_ckpt and self._io is not None:
             return self._checkpoint_async(path, reason)
         if self._io is not None:
@@ -554,6 +659,130 @@ class ResilientRunner:
             self._io.writer.drain()
         return path
 
+    def _checkpoint_sharded(self, path: str, reason: str) -> str:
+        """Distributed two-phase checkpoint (every host enters together —
+        the caller's decision was root-broadcast): fetch THIS host's
+        addressable slabs, write+fsync the shard file, barrier, exchange
+        digests, root commits the manifest (utils/checkpoint).
+
+        With the pipeline armed, a CADENCE checkpoint overlaps: the shard
+        serialization runs on this host's background writer while the
+        device steps the next chunk, and the collective commit is deferred
+        to the next chunk boundary (:meth:`_commit_pending`) — after a
+        local drain, so the barrier only ever sees fsynced shards.  Edge
+        checkpoints (anchor/final/preempt) write and commit inline."""
+        self._commit_pending()  # at most one deferred commit in flight
+        t0 = _time.monotonic()
+        snap = checkpoint.sharded_snapshot_to_host(self.pde, step=self.step)
+        snapshot_s = _time.monotonic() - t0
+        self._io_snapshot_s += snapshot_s
+        event = {
+            "event": "checkpoint",
+            "reason": reason,
+            "path": path,
+            "sharded": snap.shard_count,
+            "step": self.step,
+            "time": round(float(self.pde.get_time()), 9),
+            "snapshot_s": round(snapshot_s, 3),
+            "nu": self._nu(),
+        }
+        if self._async_ckpt and self._io is not None and reason == "cadence":
+            self._io.submit_write(
+                lambda: checkpoint.write_shard_file(snap, path),
+                checkpoint.shard_path(path, snap.shard_index),
+                nbytes=snap.nbytes,
+            )
+            self._pending_commit = (snap, path, reason, dict(event, async_=True))
+            self._last_ckpt_wall = _time.monotonic()
+            self._last_ckpt_time = float(self.pde.get_time())
+            return path
+        local_ok = True
+        try:
+            checkpoint.write_shard_file(snap, path)
+        except Exception as exc:
+            local_ok = False
+            self._journal(
+                {"event": "checkpoint_failed", "reason": reason, "error": str(exc)}
+            )
+        self._finish_sharded_commit(snap, path, reason, event, local_ok)
+        return path
+
+    def _commit_pending(self) -> None:
+        """Settle a deferred sharded cadence commit (every host calls this
+        at the same points: each chunk boundary, before any rollback/resume
+        checkpoint scan, before the next checkpoint, and at run end).
+        Drain-before-barrier: the local writer is drained first, so this
+        host's shard is durably on disk before the commit barrier."""
+        if self._pending_commit is None:
+            return
+        snap, path, reason, event = self._pending_commit
+        self._pending_commit = None
+        local_ok = True
+        if self._io is not None:
+            try:
+                self._io.writer.drain()
+            except Exception as exc:
+                local_ok = False
+                self._journal(
+                    {
+                        "event": "checkpoint_failed",
+                        "reason": reason,
+                        "error": str(exc),
+                        "step": event["step"],
+                    }
+                )
+        is_async = event.pop("async_", False)
+        self._finish_sharded_commit(
+            snap, path, reason, dict(event, **({"async": True} if is_async else {})),
+            local_ok,
+        )
+
+    def _finish_sharded_commit(
+        self, snap, path: str, reason: str, event: dict, local_ok: bool
+    ) -> None:
+        """The collective half: commit (barrier + digest allgather + root
+        manifest), rotate on success, journal the ``checkpoint_sharded``
+        telemetry (shard count, bytes/host, barrier wait seconds)."""
+        w0 = _time.monotonic()
+        stats = checkpoint.commit_sharded_snapshot(snap, path, local_ok=local_ok)
+        if not stats["ok"]:
+            if local_ok:
+                # the failing host already journaled its local cause; only
+                # hosts learning of the abort here add an event (one
+                # failure = one checkpoint_failed line per host)
+                self._journal(
+                    {
+                        "event": "checkpoint_failed",
+                        "reason": reason,
+                        "error": "sharded checkpoint aborted (a host failed "
+                        "its shard write); no manifest committed",
+                        "step": event.get("step", self.step),
+                    }
+                )
+            raise checkpoint.CheckpointError(
+                path,
+                "sharded checkpoint aborted: a host failed its shard write "
+                "(no manifest committed; the previous checkpoint is intact)",
+            )
+        if _is_root():
+            checkpoint.rotate_checkpoints(self.run_dir, self.keep)
+        with self._lock:
+            self._last_ckpt_path = path
+        self._last_ckpt_wall = _time.monotonic()
+        self._last_ckpt_time = event.get("time", float(self.pde.get_time()))
+        self._journal(
+            {
+                **event,
+                "commit_s": round(_time.monotonic() - w0, 3),
+                "checkpoint_sharded": {
+                    "shards": stats["shards"],
+                    "bytes_host": stats["bytes_host"],
+                    "bytes_total": stats["bytes_total"],
+                    "barrier_s": stats["barrier_s"],
+                },
+            }
+        )
+
     def _pick_checkpoint(self) -> str | None:
         """Newest valid checkpoint, chosen by ROOT and broadcast: each host
         scanning its own view of run_dir could disagree (filesystem
@@ -562,6 +791,10 @@ class ResilientRunner:
         next collective.  The broadcast carries the step number — the
         step-encoded filename is the cross-host contract (multihost
         resume/rollback requires run_dir on shared storage)."""
+        # an uncommitted sharded cadence checkpoint must commit (or abort)
+        # before any scan: rollback/resume must never race the two-phase
+        # window — drain-before-barrier, then manifest, then read
+        self._commit_pending()
         if self._io is not None:
             # never read/scan past an in-flight background write: rollback
             # and resume must see a settled directory (a failed write
@@ -864,18 +1097,29 @@ class ResilientRunner:
             if self.step != fault.step:
                 return  # pre-advance stopped early (signal); fire later
             fault.fired = True
-            self._journal({"event": "fault_injected", "kind": fault.kind})
+            self._journal(
+                {"event": "fault_injected", "kind": fault.kind, "host": fault.host}
+            )
             if fault.kind == "nan":
-                poison_state(pde)
+                # host-scoped or not, EVERY process dispatches the same
+                # (masked) poison computation — collective consistency
+                poison_state(pde, host=fault.host)
                 return  # run is over either way; exit() fires at the boundary
             if fault.kind == "kill":
-                os.kill(os.getpid(), signal.SIGTERM)
+                if fault.host is None:
+                    os.kill(os.getpid(), signal.SIGTERM)
+                elif fault.scoped_here():
+                    # hard single-host death (no checkpoint-then-exit): the
+                    # survivors wedge at the next collective, which the
+                    # sync watchdog converts into a structured DispatchHang
+                    os.kill(os.getpid(), signal.SIGKILL)
             elif fault.kind == "slow":
-                self._slow_pending = True
+                if fault.scoped_here():
+                    self._slow_pending = True
             elif fault.kind == "spike":
                 # finite incipient blow-up: stepping continues below, so the
                 # sentinels (or, ungoverned, the NaN criterion) see it
-                spike_state(pde, self.spike_factor)
+                spike_state(pde, self.spike_factor, host=fault.host)
             rem = n - pre
             if rem > 0:
                 self._dispatch(pde, rem)
@@ -883,6 +1127,11 @@ class ResilientRunner:
         self._advance(pde, n)
 
     def _on_chunk(self, pde) -> bool:
+        # settle a deferred sharded commit FIRST (collective; the pending
+        # flag was set at a root-broadcast cadence decision, so every host
+        # is here together) — this is where the overlapped shard write
+        # rejoins the two-phase protocol, one chunk after its submit
+        self._commit_pending()
         if self._preempt_agreed():
             return True  # integrate() returns "stopped"; run() checkpoints
         due = False
@@ -1016,6 +1265,7 @@ class ResilientRunner:
                     "io": {
                         "async_checkpoints": self._async_ckpt,
                         "overlap_dispatch": self._overlap,
+                        "sharded_checkpoints": self._sharded,
                     },
                     "fault": dataclasses.asdict(self.fault) if self.fault else None,
                 }
@@ -1085,25 +1335,43 @@ class ResilientRunner:
     def _setup_io(self) -> None:
         """Build the overlapped-IO pipeline for this run (run() entry).
 
-        Both halves need a single-process mesh: the multihost write path is
-        collective (root-decides failure barrier), and the lagged break
-        check resolves per host on device-queue timing — one host's future
-        landing a boundary earlier than another's would desynchronize the
-        collective dispatch sequence (the same reason PR-2 made cadence
-        decisions root-broadcast).  The dispatch overlap additionally needs
-        the model to offer ``exit_future``.  The model's ``io_pipeline``
-        attribute is pointed at the run's pipeline so its callback IO (flow
-        snapshots, diagnostics lines) shares the worker and lag queue —
-        restored on exit."""
+        The checkpoint FORMAT is picked here too: ``io.sharded_checkpoints``
+        ``None`` auto-selects the distributed two-phase format
+        (utils/checkpoint.write_sharded_snapshot) on multi-process runtimes
+        — the gathered writers need every shard addressable from root,
+        which a real multi-controller mesh cannot provide — and the
+        gathered single-file format otherwise; True/False force either.
+
+        Async checkpointing runs single-process AND multihost-sharded: on a
+        multihost mesh each host overlaps its own shard serialization on a
+        per-host background writer, and the collective two-phase commit is
+        deferred to the next chunk boundary — every host drains its writer
+        before the barrier (drain-before-barrier), so the manifest only
+        ever names fsynced shards.  Dispatch overlap (the lagged break
+        check) stays single-process-only: a break flag resolving on
+        per-host device-queue timing would desynchronize the collective
+        dispatch sequence, so multihost break decisions remain un-lagged
+        and root-broadcast (the same reason PR-2 made cadence decisions
+        root-broadcast).  The dispatch overlap additionally needs the model
+        to offer ``exit_future``.  The model's ``io_pipeline`` attribute is
+        pointed at the run's pipeline so its callback IO (flow snapshots,
+        diagnostics lines) shares the worker and lag queue — restored on
+        exit."""
         io = self.io
         single = _single_process()
-        self._async_ckpt = bool(io.async_checkpoints and single)
+        self._sharded = bool(
+            io.sharded_checkpoints
+            if io.sharded_checkpoints is not None
+            else not single
+        ) and hasattr(self.pde, "snapshot_state_items")
+        self._async_ckpt = bool(io.async_checkpoints and (single or self._sharded))
         self._overlap = bool(
             io.overlap_dispatch and single and hasattr(self.pde, "exit_future")
         )
+        self._pending_commit = None
         self._io_snapshot_s = 0.0  # per-run, like the pipeline's own stats
         self._saved_pde_io = getattr(self.pde, "io_pipeline", None)
-        if self._async_ckpt or self._overlap:  # implies single-process
+        if self._async_ckpt or self._overlap:
             self._io = IOPipeline(queue_depth=io.queue_depth, diag_lag=io.diag_lag)
             self.pde.io_pipeline = self._io
 
@@ -1113,6 +1381,7 @@ class ResilientRunner:
         journal one ``io_overlap`` summary: payload bytes, main-thread
         staging seconds (device fetch), worker write seconds, submitter
         seconds lost to back-pressure, and the configured queue depth."""
+        self._commit_pending()
         if self._io is not None:
             self._io.drain()
             self._journal(
@@ -1129,7 +1398,19 @@ class ResilientRunner:
         """run() exit: settle the pipeline WITHOUT masking an in-flight
         exception (write failures were either surfaced at the last
         submit/drain or remain journaled as ``checkpoint_failed``), stop
-        the worker, and give the model its previous pipeline back."""
+        the worker, and give the model its previous pipeline back.
+
+        A still-pending sharded commit is ABANDONED here, not committed:
+        teardown may be running on an exception path where the collective
+        barrier would wedge against hosts that already died.  The orphaned
+        shard files are harmless (no manifest = not committed) and the
+        rotation sweep collects them."""
+        if self._pending_commit is not None:
+            _, path, reason, _ = self._pending_commit
+            self._pending_commit = None
+            self._journal(
+                {"event": "checkpoint_abandoned", "reason": reason, "path": path}
+            )
         if self._io is not None:
             try:
                 self._io.drain(raise_errors=False)

@@ -81,6 +81,11 @@ def main() -> int:
         "dots_passed": _dots_passed(proc.stdout or ""),
         "wall_s": round(wall, 1),
         "returncode": proc.returncode,
+        # sharded-checkpoint IO counters from the last recorded
+        # shardedio129 bench row (shard count, bytes/host, gate flags) —
+        # the durability harness's footprint rides the test record so a
+        # shard-layout regression is visible across PRs
+        "sharded_io": _sharded_io_counters(),
         "date": _utc_now(),
     }
     _persist(record)
@@ -100,6 +105,25 @@ def _dots_passed(out: str) -> int:
         for line in out.splitlines()
         if progress.match(line.strip())
     )
+
+
+def _sharded_io_counters() -> dict | None:
+    """Shard/bytes counters from BENCH_FULL.json's ``shardedio129`` row
+    (None when the config was never benched on this platform)."""
+    try:
+        with open(os.path.join(_REPO, "BENCH_FULL.json")) as f:
+            row = json.load(f)["results"]["shardedio129"]
+        return {
+            "shards": row.get("shards"),
+            "bytes_host": row.get("bytes_host"),
+            "bytes_total": row.get("bytes_total"),
+            "manifest_verify_ok": row.get("manifest_verify_ok"),
+            "cross_topology_restore_equal": row.get(
+                "cross_topology_restore_equal"
+            ),
+        }
+    except (OSError, ValueError, KeyError):
+        return None
 
 
 def _utc_now() -> str:

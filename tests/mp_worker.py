@@ -1,17 +1,31 @@
 """Worker program for tests/test_multiprocess.py (not a pytest module).
 
-One process of an N-process ``jax.distributed`` run on CPU devices: builds
-the global pencil mesh, advances a sharded Navier2D, exercises the
-multihost.py host-local/global conversions + barrier, gathers the state and
-(on rank 0 only) writes a snapshot + JSON result for the parent to compare
-against a single-process run.
+One process of an N-process ``jax.distributed`` run on CPU devices.  Modes
+(argv[5], default ``basic``):
 
-argv: coordinator_port process_id num_processes out_dir
+* ``basic`` — builds the global pencil mesh, advances a sharded Navier2D,
+  exercises the multihost.py host-local/global conversions + barrier,
+  gathers the state and (on rank 0 only) writes a snapshot + JSON result
+  for the parent to compare against a single-process run.
+* ``sharded_run`` — drives a ResilientRunner over the 2-process mesh with
+  SHARDED two-phase checkpoints (utils/checkpoint.write_sharded_snapshot
+  via the runner).  Fault injection comes from the environment
+  (``RUSTPDE_FAULT`` host-scoped specs, ``RUSTPDE_SHARD_CRASH`` two-phase
+  window kills, ``RUSTPDE_SYNC_TIMEOUT_S`` barrier watchdog), so the
+  parent test can kill one host between shard fsync and manifest commit
+  and prove recovery.  Rank 0 dumps the final global state (allgathered)
+  so the parent can assert elastic restore is bit-equal.
+* ``bench_sharded`` — times sharded-vs-gathered checkpoint writes for
+  ``bench.py shardedio129`` (repetitions, bytes/host, and the final-state
+  dump for the parent's cross-topology restore gate).
+
+argv: coordinator_port process_id num_processes out_dir [mode]
 """
 
 import json
 import os
 import sys
+import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
@@ -20,34 +34,48 @@ import jax
 jax.config.update("jax_platforms", "cpu")  # sitecustomize forces axon otherwise
 
 
-def main():
-    port, pid, nproc, out_dir = (
-        sys.argv[1],
-        int(sys.argv[2]),
-        int(sys.argv[3]),
-        sys.argv[4],
-    )
-    import numpy as np
-
+def _build_model(mesh, nx=34, ny=34, dt=0.01):
     from rustpde_mpi_tpu import Navier2D
-    from rustpde_mpi_tpu.parallel import multihost
-
-    started = multihost.initialize_distributed(
-        coordinator_address=f"localhost:{port}",
-        num_processes=nproc,
-        process_id=pid,
-    )
-    assert started and jax.process_count() == nproc
-
-    mesh = multihost.global_pencil_mesh()
-    assert mesh.devices.size == nproc * len(jax.local_devices())
 
     # 34^2: spectral dims (32, 32) divide the 4-device mesh -- the
     # multi-process host-local/global conversions require divisible
     # pencil dims (JAX rejects uneven global shardings outside jit)
-    model = Navier2D(34, 34, 1e4, 1.0, 0.01, 1.0, "rbc", periodic=False, mesh=mesh)
+    model = Navier2D(nx, ny, 1e4, 1.0, dt, 1.0, "rbc", periodic=False, mesh=mesh)
     model.set_velocity(0.1, 1.0, 1.0)
     model.set_temperature(0.1, 1.0, 1.0)
+    model.write_intervall = 1e9  # runner checkpoints are the IO under test
+    return model
+
+
+def _dump_state(model, path):
+    """Rank-0 dump of the full global state (allgather) — the parent's
+    bit-equality reference for elastic restore."""
+    import numpy as np
+    from jax.experimental import multihost_utils
+
+    from rustpde_mpi_tpu.parallel import multihost
+
+    leaves = {
+        name: np.asarray(
+            multihost_utils.process_allgather(getattr(model.state, name), tiled=True)
+        )
+        for name in model.state._fields
+    }
+    multihost.sync_hosts("pre-dump")
+    if multihost.is_root():
+        np.savez(path, time=model.time, **leaves)
+    multihost.sync_hosts("post-dump")
+
+
+def mode_basic(out_dir):
+    import numpy as np
+
+    from rustpde_mpi_tpu.parallel import multihost
+
+    mesh = multihost.global_pencil_mesh()
+    assert mesh.devices.size == jax.process_count() * len(jax.local_devices())
+
+    model = _build_model(mesh)
     model.update_n(10)
     nu, nuvol, re, div = model.get_observables()
 
@@ -84,6 +112,148 @@ def main():
                 f,
             )
     multihost.sync_hosts("post-write")
+
+
+def mode_sharded_run(out_dir):
+    from rustpde_mpi_tpu import ResilientRunner
+    from rustpde_mpi_tpu.config import IOConfig
+    from rustpde_mpi_tpu.parallel import multihost
+
+    mesh = multihost.global_pencil_mesh()
+    model = _build_model(mesh)
+    # RUSTPDE_MP_BLOCKING_IO=1 pins synchronous shard writes so a
+    # SHARD_CRASH kill lands deterministically inside the two-phase window
+    # (async submits would race the surviving host's next dispatch)
+    io = (
+        IOConfig(async_checkpoints=False, overlap_dispatch=False, diag_lag=0)
+        if os.environ.get("RUSTPDE_MP_BLOCKING_IO") == "1"
+        else None
+    )
+    runner = ResilientRunner(
+        model,
+        max_time=0.2,
+        save_intervall=0.05,
+        run_dir=os.path.join(out_dir, "run"),
+        checkpoint_every_s=None,
+        checkpoint_every_t=0.05,
+        keep=3,
+        io=io,
+    )
+    summary = runner.run()  # a SHARD_CRASH/FAULT env kills us mid-protocol
+    _dump_state(model, os.path.join(out_dir, "final_state.npz"))
+    if multihost.is_root():
+        with open(os.path.join(out_dir, "result.json"), "w") as f:
+            json.dump(
+                {
+                    "outcome": summary["outcome"],
+                    "step": summary["step"],
+                    "time": summary["time"],
+                    "checkpoint": summary["checkpoint"],
+                    "sharded": True,
+                    "nproc": jax.process_count(),
+                },
+                f,
+            )
+
+
+def mode_bench_sharded(out_dir, reps=3):
+    import numpy as np
+    from jax.experimental import multihost_utils
+
+    from rustpde_mpi_tpu.parallel import multihost
+    from rustpde_mpi_tpu.utils import checkpoint as cp
+
+    mesh = multihost.global_pencil_mesh()
+    nx = int(os.environ.get("RUSTPDE_BENCH_SHARDED_N", "130"))
+    model = _build_model(mesh, nx=nx, ny=nx, dt=2e-3)
+    model.update_n(4)
+
+    # sharded leg: the collective two-phase writer, timed end to end
+    sharded_s = []
+    stats = None
+    for rep in range(reps):
+        path = cp.checkpoint_path(os.path.join(out_dir, "sharded"), rep)
+        multihost.sync_hosts("bench-sharded-start")
+        t0 = time.perf_counter()
+        stats = cp.write_sharded_snapshot(model, path, step=rep)
+        sharded_s.append(time.perf_counter() - t0)
+    manifest = cp.checkpoint_path(os.path.join(out_dir, "sharded"), reps - 1)
+
+    # gathered leg: what multihost checkpointing had to do before the
+    # sharded path existed — allgather every leaf to every host, root
+    # serializes the full state
+    gathered_s = []
+    for rep in range(reps):
+        multihost.sync_hosts("bench-gathered-start")
+        t0 = time.perf_counter()
+        leaves = [
+            np.asarray(
+                multihost_utils.process_allgather(
+                    getattr(model.state, name), tiled=True
+                )
+            )
+            for name in model.state._fields
+        ]
+        if multihost.is_root():
+            items = []
+            for name, arr in zip(model.state._fields, leaves):
+                if np.iscomplexobj(arr):
+                    items.append((f"state/{name}_re", np.ascontiguousarray(arr.real), "raw"))
+                    items.append((f"state/{name}_im", np.ascontiguousarray(arr.imag), "raw"))
+                else:
+                    items.append((f"state/{name}", arr, "raw"))
+            snap = cp.HostSnapshot(datasets=items, step=rep, time=model.time)
+            cp.write_host_snapshot(
+                snap, os.path.join(out_dir, f"gathered_{rep}.h5")
+            )
+        multihost.sync_hosts("bench-gathered-end")
+        gathered_s.append(time.perf_counter() - t0)
+
+    _dump_state(model, os.path.join(out_dir, "final_state.npz"))
+    if multihost.is_root():
+        with open(os.path.join(out_dir, "result.json"), "w") as f:
+            json.dump(
+                {
+                    "sharded_write_s": min(sharded_s),
+                    "gathered_write_s": min(gathered_s),
+                    "bytes_host": stats["bytes_host"],
+                    "bytes_total": stats["bytes_total"],
+                    "shards": stats["shards"],
+                    "barrier_s": stats["barrier_s"],
+                    "manifest": manifest,
+                    "grid": [nx, nx],
+                    "nproc": jax.process_count(),
+                },
+                f,
+            )
+
+
+def main():
+    port, pid, nproc, out_dir = (
+        sys.argv[1],
+        int(sys.argv[2]),
+        int(sys.argv[3]),
+        sys.argv[4],
+    )
+    mode = sys.argv[5] if len(sys.argv) > 5 else "basic"
+
+    from rustpde_mpi_tpu.parallel import multihost
+
+    started = multihost.initialize_distributed(
+        coordinator_address=f"localhost:{port}",
+        num_processes=nproc,
+        process_id=pid,
+    )
+    assert started and jax.process_count() == nproc
+
+    if mode == "basic":
+        mode_basic(out_dir)
+    elif mode == "sharded_run":
+        mode_sharded_run(out_dir)
+    elif mode == "bench_sharded":
+        mode_bench_sharded(out_dir)
+    else:
+        raise SystemExit(f"unknown mode {mode!r}")
     print(f"RANK{pid} OK", flush=True)
 
 

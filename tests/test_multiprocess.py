@@ -12,66 +12,33 @@ compares bit-level against a single-process run of the same model.
 
 import json
 import os
-import socket
-import subprocess
-import sys
 
 import numpy as np
 import pytest
 
+from mp_harness import spawn_cluster  # tests/ dir is on sys.path under pytest
+
 pytestmark = pytest.mark.slow  # heavyweight end-to-end tier (VERDICT r3 #8)
 
-_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 _NPROC = 2
 
 
-def _free_port() -> int:
-    s = socket.socket()
-    s.bind(("localhost", 0))
-    port = s.getsockname()[1]
-    s.close()
-    return port
+def _spawn(out_dir, mode=None, env_extra=None, check=True):
+    """spawn_cluster with the suite's timeout policy: a spawn timeout in
+    this environment is a skip, not a failure."""
+    outs = spawn_cluster(
+        out_dir, mode=mode, nproc=_NPROC, env_extra=env_extra, check=check
+    )
+    if outs is None:
+        pytest.skip("multi-process spawn timed out in this environment")
+    return outs
 
 
 @pytest.fixture(scope="module")
 def mp_result(tmp_path_factory):
     out_dir = str(tmp_path_factory.mktemp("mp"))
-    port = _free_port()
-    env = dict(
-        os.environ,
-        JAX_PLATFORMS="cpu",
-        XLA_FLAGS="--xla_force_host_platform_device_count=2",
-        RUSTPDE_X64="1",
-    )
-    procs = [
-        subprocess.Popen(
-            [
-                sys.executable,
-                os.path.join(_REPO, "tests", "mp_worker.py"),
-                str(port),
-                str(i),
-                str(_NPROC),
-                out_dir,
-            ],
-            stdout=subprocess.PIPE,
-            stderr=subprocess.PIPE,
-            text=True,
-            env=env,
-            cwd=_REPO,
-        )
-        for i in range(_NPROC)
-    ]
-    outs = []
-    try:
-        for p in procs:
-            out, err = p.communicate(timeout=600)
-            outs.append((p.returncode, out, err))
-    except subprocess.TimeoutExpired:
-        for p in procs:
-            p.kill()
-        pytest.skip("multi-process spawn timed out in this environment")
+    outs = _spawn(out_dir)
     for rc, out, err in outs:
-        assert rc == 0, f"worker failed (rc={rc}):\n{err[-3000:]}"
         assert "OK" in out
     with open(os.path.join(out_dir, "result.json")) as f:
         return json.load(f), out_dir
@@ -115,3 +82,114 @@ def test_multiprocess_snapshot_written(mp_result):
     with h5py.File(os.path.join(out_dir, "snapshot_mp.h5")) as f:
         temp = f["temp"][...]
     np.testing.assert_allclose(temp, np.asarray(model.state.temp), atol=1e-12)
+
+
+# -- sharded two-phase checkpoints across real processes ----------------------
+
+
+def _serial_34():
+    from rustpde_mpi_tpu import Navier2D
+
+    model = Navier2D(34, 34, 1e4, 1.0, 0.01, 1.0, "rbc", periodic=False)
+    model.set_velocity(0.1, 1.0, 1.0)
+    model.set_temperature(0.1, 1.0, 1.0)
+    model.write_intervall = 1e9
+    return model
+
+
+def test_sharded_two_phase_kill_then_resume(tmp_path):
+    """THE two-phase durability proof across real processes:
+
+    1. a 2-process resilient run with sharded checkpoints is killed on
+       host 1 BETWEEN its shard fsync and the manifest commit
+       (``RUSTPDE_SHARD_CRASH=after_shard@10:host1``) — host 0 wedges at
+       the commit barrier and the sync watchdog converts that into a
+       structured exit, so NO manifest for step 10 ever appears;
+    2. the previous cadence checkpoint (step 5) is digest-clean and
+       ``latest_checkpoint`` picks it — the aborted attempt's orphan
+       shards are invisible to resume;
+    3. a fresh 2-process run on the same run_dir auto-resumes from step 5
+       and completes, its final sharded checkpoint verifying end-to-end;
+    4. elastic restore: the final manifest (written by 2 processes over a
+       4-device mesh) restores onto a plain SERIAL model in this parent
+       process, bit-equal to the workers' dumped global state."""
+    from rustpde_mpi_tpu.utils import checkpoint as cp
+
+    out_dir = str(tmp_path / "mpshard")
+    os.makedirs(out_dir, exist_ok=True)
+    run_dir = os.path.join(out_dir, "run")
+
+    outs = _spawn(
+        out_dir,
+        "sharded_run",
+        env_extra={
+            "RUSTPDE_SHARD_CRASH": "after_shard@10:host1",
+            "RUSTPDE_SYNC_TIMEOUT_S": "30",
+            "RUSTPDE_MP_BLOCKING_IO": "1",
+        },
+        check=False,  # rcs asserted per rank below (9 / nonzero expected)
+    )
+    assert outs[1][0] == 9, f"host1 should die at the crash hook: {outs[1][2][-2000:]}"
+    assert outs[0][0] != 0, "host0 must not report success after losing its peer"
+    # no manifest for the aborted step-10 attempt; its orphan shards may exist
+    assert not os.path.exists(cp.checkpoint_path(run_dir, 10))
+    latest = cp.latest_checkpoint(run_dir)
+    assert latest is not None
+    attrs = cp.verify_snapshot(latest)  # manifest + every shard digest-clean
+    assert int(attrs["step"]) == 5
+    assert int(attrs["sharded"]) == _NPROC
+
+    # clean rerun resumes from the surviving checkpoint and completes
+    _spawn(out_dir, "sharded_run")
+    with open(os.path.join(out_dir, "result.json")) as f:
+        result = json.load(f)
+    assert result["outcome"] == "done"
+    assert result["step"] == 20
+    events = []
+    with open(os.path.join(run_dir, "journal.jsonl")) as fh:
+        events = [json.loads(line) for line in fh]
+    resumed = [e for e in events if e["event"] == "resumed"]
+    assert resumed and resumed[-1]["step"] == 5
+    sharded_ckpts = [e for e in events if e.get("checkpoint_sharded")]
+    assert sharded_ckpts, "journal must carry checkpoint_sharded telemetry"
+    row = sharded_ckpts[-1]["checkpoint_sharded"]
+    assert row["shards"] == _NPROC and row["bytes_host"] > 0
+
+    # elastic restore onto a serial model, bit-equal to the dumped state
+    final = result["checkpoint"]
+    assert int(cp.verify_snapshot(final)["step"]) == 20
+    dumped = np.load(os.path.join(out_dir, "final_state.npz"))
+    model = _serial_34()
+    model.read(final)
+    for name in model.state._fields:
+        np.testing.assert_array_equal(
+            np.asarray(getattr(model.state, name)), dumped[name], err_msg=name
+        )
+    assert model.time == pytest.approx(float(dumped["time"]))
+
+
+def test_sharded_multiprocess_matches_serial_run(tmp_path):
+    """A clean 2-process sharded-checkpoint run equals the serial model
+    driven over the same horizon (the resilience layer must not perturb
+    the physics), and its checkpoints restore across topologies."""
+    from rustpde_mpi_tpu import integrate
+    from rustpde_mpi_tpu.utils import checkpoint as cp
+
+    out_dir = str(tmp_path / "mpclean")
+    os.makedirs(out_dir, exist_ok=True)
+    _spawn(out_dir, "sharded_run")
+    with open(os.path.join(out_dir, "result.json")) as f:
+        result = json.load(f)
+    assert result["outcome"] == "done"
+
+    model = _serial_34()
+    integrate(model, 0.2, 0.05)
+    restored = _serial_34()
+    restored.read(result["checkpoint"])
+    for name in model.state._fields:
+        np.testing.assert_allclose(
+            np.asarray(getattr(restored.state, name)),
+            np.asarray(getattr(model.state, name)),
+            atol=1e-12,
+            err_msg=name,
+        )
