@@ -13,7 +13,7 @@ Environment knobs:
                                   poisson1025_f64, rbc1025, rbc1025_f64,
                                   sh2048, rbc2049, rbc2049_f64, rbc129_f64,
                                   ensemble129, resilience129, governor129,
-                                  pipeline129, shardedio129
+                                  pipeline129, shardedio129, serve129
     RUSTPDE_BENCH_STEPS    timed window for the primary config (default 64;
                            rates are slope-timed over windows L and 4L, see
                            utils/profiling.benchmark_steps)
@@ -71,6 +71,7 @@ DEFAULT_CONFIGS = [
     "governor129",
     "pipeline129",
     "shardedio129",
+    "serve129",
     "periodic",
     "poisson1025",
     "poisson1025_f64",
@@ -96,6 +97,7 @@ METRIC_NAMES = {
     "governor129": "2D RBC confined 129x129 Ra=1e7 stability governor (sentinel overhead + spike catch)",
     "pipeline129": "2D RBC confined 129x129 Ra=1e7 overlapped I/O pipeline (async checkpoints + dispatch double-buffering)",
     "shardedio129": "2D RBC sharded two-phase checkpoints, 2-proc CPU harness (sharded vs gathered write + elastic-restore gate)",
+    "serve129": "2D RBC simulation service 129x129 Ra=1e7, 200 requests / 8 slots soak (drain+NaN chaos; member-steps/s + latency percentiles)",
     "periodic": "2D RBC periodic 128x65 Ra=1e6",
     "periodic1024": "2D RBC periodic 1024x1025 Ra=1e9",
     "poisson1025": "Poisson standalone 1025x1025",
@@ -626,6 +628,164 @@ print(json.dumps({"verify_ok": True, "restore_equal": bool(equal),
         shutil.rmtree(out_dir, ignore_errors=True)
 
 
+def bench_serve(nx=129, ny=129, ra=1e7, dt=2e-3, steps_per_req=8):
+    """serve129: the simulation-service soak (rustpde_mpi_tpu/serve/).
+
+    Drives RUSTPDE_SERVE_BENCH_REQUESTS (default 200) queued requests
+    through 8 continuously-batched ensemble slots across TWO process
+    incarnations of examples/navier_rbc_serve.py — phase 1 is
+    SIGTERM-drained mid-soak by a ``kill@`` fault (graceful drain:
+    sharded slot-table checkpoint + re-enqueue), phase 2 restarts,
+    restores the drained slots mid-trajectory, injects a batch-wide NaN
+    (``nan@``: every in-flight request retries at dt/2) and drains the
+    queue.  The hard-SIGKILL leg lives in the slow-tier chaos soak test
+    (tests/test_serve.py) — the bench keeps two phases so its wall stays
+    inside the driver budget.
+
+    Reported: aggregate member-steps/s (dispatched work over serve wall,
+    retry detours included), completed member-steps, and per-request
+    latency percentiles (p50/p90/p99 of submit->resolve).  The red/green
+    gate is the robustness contract, not a threshold: every request
+    terminally resolved with ZERO lost and ZERO failed, the drain +
+    restore + retry events all present in the journal, and a sample of
+    results matching SOLO single-model reruns (per-request isolation
+    against ground truth)."""
+    import shutil
+    import subprocess
+    import tempfile
+
+    import numpy as np
+
+    from rustpde_mpi_tpu import config
+    from rustpde_mpi_tpu.serve import DurableQueue
+    from rustpde_mpi_tpu.utils.journal import read_journal
+
+    config.enable_compilation_cache()
+    n_req = int(os.environ.get("RUSTPDE_SERVE_BENCH_REQUESTS", "200"))
+    horizon = steps_per_req * dt
+    run_dir = tempfile.mkdtemp(prefix="bench_serve_")
+    env = dict(os.environ)
+    env.pop("RUSTPDE_FAULT", None)
+
+    def phase(extra, timeout=1500):
+        t0 = time.perf_counter()
+        proc = subprocess.run(
+            [
+                sys.executable,
+                os.path.join(_REPO, "examples", "navier_rbc_serve.py"),
+                "--nx", str(nx), "--ny", str(ny), "--ra", str(ra),
+                "--dt", str(dt), "--horizon", str(horizon),
+                # staggered horizons (+0..5 steps by seed): completions stop
+                # aligning on one boundary, so drains catch work in flight —
+                # the continuous-batching shape real mixed traffic has
+                "--horizon-jitter", "6",
+                "--slots", "8", "--max-queue", str(2 * n_req),
+                "--run-dir", run_dir, "--ckpt-every-s", "10",
+                *extra,
+            ],
+            capture_output=True, text=True, timeout=timeout, env=env,
+            cwd=_REPO,
+        )
+        wall = time.perf_counter() - t0
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"serve phase {extra} rc={proc.returncode}: "
+                f"{proc.stderr[-1500:]}"
+            )
+        # the summary shares stdout with checkpoint-restore prints and the
+        # per-request result lines: take the json line
+        summary = next(
+            json.loads(line)
+            for line in proc.stdout.splitlines()
+            if line.startswith('{"outcome"')
+        )
+        return summary, wall
+
+    try:
+        # phase 1: enqueue all, serve until the kill@ SIGTERM drains
+        drain_at = max(3 * steps_per_req, 24)
+        s1, wall1 = phase(
+            ["--requests", str(n_req), "--fault", f"kill@{drain_at}"]
+        )
+        # phase 2: restore the drained slots, NaN the batch mid-soak, finish
+        s2, wall2 = phase(["--fault", f"nan@{2 * drain_at}"], timeout=2400)
+
+        q = DurableQueue(os.path.join(run_dir, "queue"), max_queue=2 * n_req)
+        counts = q.counts()
+        done_dir = os.path.join(run_dir, "queue", "done")
+        latencies, completed_steps, sampled = [], 0, []
+        for name in sorted(os.listdir(done_dir)):
+            with open(os.path.join(done_dir, name)) as fh:
+                res = json.load(fh)["result"]
+            latencies.append(res["latency_s"])
+            completed_steps += res["steps"]
+            sampled.append(res)
+        events = [
+            e.get("event")
+            for e in read_journal(os.path.join(run_dir, "journal.jsonl"))
+        ]
+
+        # isolation spot-check vs solo ground truth (subprocess: inherits
+        # this run's precision mode + compile cache)
+        iso_diffs = []
+        for res in sampled[:: max(1, len(sampled) // 3)][:3]:
+            code = (
+                "import os, jax; jax.config.update('jax_platforms', "
+                "os.environ.get('JAX_PLATFORMS') or jax.default_backend()); "
+                "from rustpde_mpi_tpu import Navier2D; "
+                f"m = Navier2D({nx},{ny},{ra},1.0,{res['dt']},1.0,'rbc',periodic=False); "
+                f"m.init_random({res['amp'] or 0.1}, seed={res['seed']}); "
+                f"m.update_n({res['steps']}); print(float(m.eval_nu()))"
+            )
+            out = subprocess.run(
+                [sys.executable, "-c", code], capture_output=True, text=True,
+                timeout=900, env=env, cwd=_REPO,
+            )
+            solo = float(out.stdout.strip().splitlines()[-1])
+            iso_diffs.append(abs(res["nu"] - solo) / max(abs(solo), 1e-30))
+        iso_tol = 1e-8 if os.environ.get("RUSTPDE_X64") == "1" else 1e-3
+
+        lat = np.sort(np.asarray(latencies)) if latencies else np.zeros(1)
+        pct = lambda p: float(lat[min(len(lat) - 1, int(p / 100 * len(lat)))])
+        member_steps = s1.get("member_steps", 0) + s2.get("member_steps", 0)
+        serve_wall = s1.get("wall_s", wall1) + s2.get("wall_s", wall2)
+        gates = {
+            "zero_lost": counts["queued"] == 0 and counts["running"] == 0,
+            "all_completed": counts["done"] == n_req,
+            "zero_failed": counts["failed"] == 0,
+            "drained_mid_soak": s1.get("outcome") == "drained"
+            and "request_requeued" in events,
+            "restored_mid_trajectory": any(
+                e == "request_scheduled" for e in events
+            ),
+            "nan_retries_fired": "request_retry" in events,
+            "isolation_vs_solo": bool(iso_diffs)
+            and max(iso_diffs) < iso_tol,
+        }
+        return {
+            # aggregate throughput across the full chaos cycle (dispatched
+            # member-steps over serve wall, retry detours + drain included)
+            "member_steps_per_sec": member_steps / serve_wall,
+            "steps_per_sec": member_steps / serve_wall / 8.0,
+            "completed_member_steps": completed_steps,
+            "dispatched_member_steps": member_steps,
+            "requests": n_req,
+            "slots": 8,
+            "steps_per_request": steps_per_req,
+            "retries": s1.get("retried", 0) + s2.get("retried", 0),
+            "latency_p50_s": pct(50),
+            "latency_p90_s": pct(90),
+            "latency_p99_s": pct(99),
+            "latency_mean_s": float(np.mean(lat)),
+            "isolation_max_rel_diff": max(iso_diffs) if iso_diffs else None,
+            "phase_wall_s": [round(wall1, 1), round(wall2, 1)],
+            "gates": gates,
+            "finite": all(gates.values()),
+        }
+    finally:
+        shutil.rmtree(run_dir, ignore_errors=True)
+
+
 def bench_resilience(nx, ny, ra, dt, steps):
     """Recovery-overhead config (utils/resilience.py): the same horizon run
     twice — once clean (plain ``integrate``), once under a
@@ -1003,6 +1163,10 @@ def main() -> int:
             elif name == "shardedio129":
                 # 2-process CPU cluster (durability harness, chip-independent)
                 r = bench_sharded_io()
+            elif name == "serve129":
+                # simulation-service soak: 200 requests through 8 slots in
+                # subprocess incarnations (drain + NaN chaos cycle)
+                r = bench_serve()
             elif name == "governor129":
                 # overhead leg slope-times two chains; the spike legs rerun
                 # a capped horizon (governed: at the descended-ladder dt)

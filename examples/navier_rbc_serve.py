@@ -1,0 +1,122 @@
+"""The simulation service: continuously-batched ensemble serving.
+
+Run a service that accepts Rayleigh–Bénard simulation requests through a
+durable on-disk queue (and optionally a thin HTTP front), batches
+compatible requests into ensemble slots LLM-style, and streams per-request
+results back as each resolves — surviving NaN members, SIGTERM drains and
+hard kills along the way (rerun the same command to recover).
+
+Batch mode — enqueue a sweep and drain the queue::
+
+    python examples/navier_rbc_serve.py --quick --requests 24
+
+Chaos: inject a NaN into the running batch (per-request retry at dt/2),
+or SIGTERM/SIGKILL the process mid-flight and rerun to resume::
+
+    python examples/navier_rbc_serve.py --quick --requests 24 --fault nan@40
+
+Daemon mode with the HTTP front (Ctrl-C drains gracefully)::
+
+    python examples/navier_rbc_serve.py --daemon --http-port 8808
+    curl -X POST localhost:8808/requests -d '{"ra":1e4,"nx":17,"ny":17,"dt":0.01,"horizon":0.2}'
+    curl localhost:8808/stats
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from rustpde_mpi_tpu import RequestFailed  # noqa: E402
+from rustpde_mpi_tpu.config import ServeConfig  # noqa: E402
+from rustpde_mpi_tpu.serve import AdmissionError, SimServer  # noqa: E402
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true", help="small fast config")
+    ap.add_argument("--requests", type=int, default=0,
+                    help="enqueue this many requests before serving")
+    ap.add_argument("--nx", type=int, default=None)
+    ap.add_argument("--ny", type=int, default=None)
+    ap.add_argument("--ra", type=float, default=None)
+    ap.add_argument("--dt", type=float, default=None)
+    ap.add_argument("--horizon", type=float, default=None)
+    ap.add_argument("--slots", type=int, default=4)
+    ap.add_argument("--max-queue", type=int, default=256)
+    ap.add_argument("--run-dir", default="data/serve")
+    ap.add_argument("--ckpt-every-s", type=float, default=60.0)
+    ap.add_argument("--daemon", action="store_true",
+                    help="keep serving after the queue drains")
+    ap.add_argument("--http-port", type=int, default=None,
+                    help="enable the HTTP front on this port (0 = ephemeral)")
+    ap.add_argument("--fault", default=None,
+                    help="nan@<step> | spike@<step> | kill@<step> | slow@<step>")
+    ap.add_argument("--drain-after-s", type=float, default=None,
+                    help="request a graceful drain this many seconds in "
+                    "(the soak harness's deterministic SIGTERM stand-in)")
+    ap.add_argument("--horizon-jitter", type=int, default=0,
+                    help="stagger request horizons by (seed %% N) extra "
+                    "steps: slot completions stop aligning on one boundary, "
+                    "which is what makes continuous batching (and drains "
+                    "that catch work in flight) realistic")
+    args = ap.parse_args()
+
+    if args.quick:
+        nx, ny, ra, dt, horizon = 17, 17, 1e4, 1e-2, 0.2
+    else:
+        nx, ny, ra, dt, horizon = 65, 65, 1e6, 2e-3, 1.0
+    nx, ny = args.nx or nx, args.ny or ny
+    ra, dt = args.ra or ra, args.dt or dt
+    horizon = args.horizon or horizon
+
+    cfg = ServeConfig(
+        run_dir=args.run_dir,
+        slots=args.slots,
+        max_queue=args.max_queue,
+        checkpoint_every_s=args.ckpt_every_s,
+        idle_exit=not args.daemon,
+        http_port=args.http_port,
+    )
+    server = SimServer(cfg, fault=args.fault)
+
+    ids = []
+    for seed in range(args.requests):
+        h = horizon
+        if args.horizon_jitter:
+            h += (seed % args.horizon_jitter) * dt
+        try:
+            req = server.submit(
+                {"ra": ra, "pr": 1.0, "nx": nx, "ny": ny, "dt": dt,
+                 "horizon": h, "seed": seed}
+            )
+        except AdmissionError as exc:
+            print(f"request {seed} rejected: {exc}", file=sys.stderr)
+            continue
+        ids.append(req.id)
+
+    if args.drain_after_s is not None:
+        import threading
+
+        threading.Timer(args.drain_after_s, server.request_drain).start()
+    summary = server.serve()
+    print(json.dumps(summary))
+
+    failed = 0
+    for rid in ids:
+        try:
+            result = server.result(rid)
+        except RequestFailed as exc:
+            print(f"  {rid}: FAILED — {exc}", file=sys.stderr)
+            failed += 1
+            continue
+        if result is not None:
+            print(f"  {rid}: nu={result['nu']:.6g} steps={result['steps']} "
+                  f"latency={result['latency_s']:.2f}s")
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -46,6 +46,14 @@ from .utils.io_pipeline import (  # noqa: F401
     IOPipeline,
     ObservableFuture,
 )
+from .serve import (  # noqa: F401
+    AdmissionError,
+    RequestFailed,
+    SimRequest,
+    SimServer,
+)
+from .utils.checkpoint import CheckpointError  # noqa: F401
+from .utils.faults import FaultSpecError  # noqa: F401
 from .utils.resilience import (  # noqa: F401
     DispatchHang,
     DivergenceError,

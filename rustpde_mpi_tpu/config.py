@@ -245,6 +245,67 @@ class ResilienceConfig:
 
 
 @dataclass
+class ServeConfig:
+    """Knobs for the fault-isolated simulation service
+    (:class:`~rustpde_mpi_tpu.serve.SimServer`): a persistent driver that
+    accepts simulation requests through a durable on-disk queue (plus an
+    optional thin HTTP front), bucket-batches compatible requests into
+    :class:`~rustpde_mpi_tpu.models.ensemble.NavierEnsemble` slots
+    LLM-style, and streams per-request observables back as each resolves.
+
+    * ``run_dir`` — service state root: the durable queue lives under
+      ``<run_dir>/queue``, campaign checkpoints under
+      ``<run_dir>/campaigns/<key>``, and every runner + ``request_*`` event
+      rides ONE ``<run_dir>/journal.jsonl``,
+    * ``slots`` — ensemble members per campaign batch (the K of the vmapped
+      dispatch); a finished/failed/cancelled member's slot is refilled from
+      the queue mid-campaign without recompiling,
+    * ``max_queue`` — admission-control bound: a submit past this depth is
+      rejected with a typed reason (bounded memory + latency instead of an
+      unbounded backlog),
+    * ``chunk_steps`` — upper bound on steps per dispatch between schedule
+      points (slot completions land exactly on chunk boundaries because the
+      chunk is also capped by the minimum remaining steps of any running
+      slot),
+    * ``checkpoint_every_s`` — wall-clock cadence for slot-table
+      checkpoints (None: only drain/edge checkpoints); serve checkpoints
+      always use the sharded two-phase writer, carrying the slot table as
+      digest-covered manifest data so restarts rebuild it from the
+      checkpoint alone,
+    * ``request_max_retries`` / ``request_dt_backoff`` — per-request
+      divergence policy: a diverged request is re-queued at
+      ``dt * backoff`` (a new compatibility bucket) up to the retry budget,
+      then lands in the ``failed/`` terminal state with a typed
+      :class:`~rustpde_mpi_tpu.serve.RequestFailed` record,
+    * ``default_amp`` — initial-condition amplitude for requests that do
+      not specify one,
+    * ``idle_exit`` — return from :meth:`serve` once the queue is empty and
+      every slot resolved (the batch/soak mode); False keeps the service
+      waiting for new work (the daemon mode),
+    * ``poll_s`` — idle-queue poll interval in daemon mode,
+    * ``http_host``/``http_port`` — thin HTTP front (``http_port=None``
+      disables it; 0 binds an ephemeral port, reported by ``http_address``),
+    * ``resilience`` — runner knobs for the embedded
+      :class:`~rustpde_mpi_tpu.utils.resilience.ResilientRunner` (fault
+      injection, watchdogs, governor); ``run_dir``/``resume`` fields are
+      overridden per campaign by the scheduler."""
+
+    run_dir: str = "data/serve"
+    slots: int = 8
+    max_queue: int = 256
+    chunk_steps: int = 256
+    checkpoint_every_s: float | None = 60.0
+    request_max_retries: int = 2
+    request_dt_backoff: float = 0.5
+    default_amp: float = 0.1
+    idle_exit: bool = True
+    poll_s: float = 0.2
+    http_host: str = "127.0.0.1"
+    http_port: int | None = None
+    resilience: ResilienceConfig | None = None
+
+
+@dataclass
 class NavierConfig:
     """Configuration dataclass for the Navier models (SURVEY.md S5: the
     reference passes bare constructor arguments and mutates public fields,

@@ -156,9 +156,30 @@ class NavierEnsemble(Integrate):
     def ny(self) -> int:
         return self.model.ny
 
+    @property
+    def compat_key(self) -> tuple:
+        """The template model's operator-constant key
+        (:attr:`Navier2D.compat_key`): members NECESSARILY share it — the
+        batch is one vmapped jaxpr over shared constants — so a slot can be
+        refilled mid-campaign (``set_member``) by any request with an equal
+        key, without recompiling."""
+        return self.model.compat_key
+
     def member_state(self, i: int) -> NavierState:
         """Member ``i``'s state as an unbatched :class:`NavierState`."""
         return jax.tree.map(lambda x: x[i], self.state)
+
+    def fresh_member_state(self, seed: int, amp: float = 0.1) -> NavierState:
+        """A new random-IC member state from the template model's generator
+        (the slot-refill donor for a freshly admitted request): the model's
+        own state is restored afterwards, and the returned state is ready
+        for :meth:`set_member` — same shapes/dtypes by construction."""
+        keep = self.model.state
+        try:
+            self.model.init_random(float(amp), seed=int(seed))
+            return self.model.state
+        finally:
+            self.model.state = keep
 
     def set_member(self, i: int, state: NavierState) -> None:
         """Replace member ``i``'s state (and re-derive its mask/counter)."""

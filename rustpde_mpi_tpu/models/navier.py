@@ -402,6 +402,25 @@ class Navier2D(Integrate):
 
         return device_put(arr, SPEC)
 
+    @property
+    def compat_key(self) -> tuple:
+        """Everything baked into the model's operator constants — grid,
+        physics parameters, dt (the implicit solvers factorize ``dt*nu``),
+        geometry and BC family.  Two requests with equal keys can share one
+        compiled step jaxpr (and therefore one ensemble batch: the serve
+        scheduler buckets by this key); anything differing forces a fresh
+        model build + compile."""
+        return (
+            int(self.nx),
+            int(self.ny),
+            float(self.params["ra"]),
+            float(self.params["pr"]),
+            float(self.dt),
+            float(self.scale[0]),
+            str(self.bc),
+            bool(self.periodic),
+        )
+
     # -- construction --------------------------------------------------------
 
     @classmethod

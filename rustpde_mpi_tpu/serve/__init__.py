@@ -1,0 +1,25 @@
+"""Fault-isolated simulation service: continuously-batched ensemble serving.
+
+The layer that accepts simulation work from the outside and survives the
+failures multi-tenancy produces (see serve/scheduler.py for the design):
+
+* :class:`SimServer` — durable-queue + continuous-batching scheduler over
+  :class:`~rustpde_mpi_tpu.models.ensemble.NavierEnsemble` slots,
+* :class:`SimRequest` — the unit of work (Ra/Pr/resolution/geometry/
+  horizon), bucketed by operator-constant compatibility key,
+* :class:`DurableQueue` — crash-safe on-disk request lifecycle,
+* :class:`RequestFailed` / :class:`AdmissionError` / :class:`RequestError`
+  — the typed failure surface (terminal divergence, bounded-queue
+  backpressure, malformed work),
+* :class:`HttpFront` — optional thin stdlib HTTP front.
+"""
+
+from .http_front import HttpFront  # noqa: F401
+from .queue import DurableQueue  # noqa: F401
+from .request import (  # noqa: F401
+    AdmissionError,
+    RequestError,
+    RequestFailed,
+    SimRequest,
+)
+from .scheduler import SimServer  # noqa: F401

@@ -1,0 +1,105 @@
+"""Thin HTTP front for the simulation service (stdlib only).
+
+A :class:`~http.server.ThreadingHTTPServer` on a daemon thread, speaking a
+five-endpoint JSON protocol over the :class:`~.scheduler.SimServer`'s
+thread-safe surface::
+
+    POST /requests        {"ra":1e4,"horizon":0.1,...}  -> 202 {"id": ...}
+                          429 {"error","reason"} on admission rejection
+                          400 on a malformed request body
+    GET  /requests/<id>   lifecycle record               (404 unknown)
+    GET  /stats           queue counts + throughput counters
+    GET  /healthz         {"ok": true, "draining": ...}
+    POST /drain           ask the service to drain       -> 202
+
+Durability lives BELOW this layer: a submit is acknowledged only after the
+queue fsynced the request file, so an accepted 202 survives any crash.
+The front is deliberately minimal — no auth, no TLS, bind it to loopback
+(the default) and put a real proxy in front for anything public.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from .request import AdmissionError, RequestError
+
+
+class HttpFront:
+    """Lifecycle wrapper: ``start()`` binds (port 0 = ephemeral, see
+    ``address``), ``stop()`` shuts the listener down.  Handlers call the
+    server's thread-safe methods only."""
+
+    def __init__(self, sim_server, host: str = "127.0.0.1", port: int = 0):
+        self.sim = sim_server
+        self._httpd = ThreadingHTTPServer((host, port), self._make_handler())
+        self._httpd.daemon_threads = True
+        self._thread: threading.Thread | None = None
+
+    @property
+    def address(self) -> tuple[str, int]:
+        return self._httpd.server_address[:2]
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="serve-http", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=10.0)
+            self._thread = None
+
+    def _make_handler(self):
+        sim = self.sim
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):  # quiet: the journal is the log
+                pass
+
+            def _reply(self, code: int, payload: dict) -> None:
+                body = json.dumps(payload).encode("utf-8")
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path == "/healthz":
+                    return self._reply(
+                        200, {"ok": True, "draining": sim._drain}
+                    )
+                if self.path == "/stats":
+                    return self._reply(200, sim.stats())
+                if self.path.startswith("/requests/"):
+                    status = sim.status(self.path.rsplit("/", 1)[-1])
+                    if status is None:
+                        return self._reply(404, {"error": "unknown request id"})
+                    return self._reply(200, status)
+                return self._reply(404, {"error": "unknown endpoint"})
+
+            def do_POST(self):
+                if self.path == "/drain":
+                    sim.request_drain()
+                    return self._reply(202, {"draining": True})
+                if self.path != "/requests":
+                    return self._reply(404, {"error": "unknown endpoint"})
+                try:
+                    length = int(self.headers.get("Content-Length", "0"))
+                    data = json.loads(self.rfile.read(length) or b"{}")
+                    req = sim.submit(data)
+                except AdmissionError as exc:
+                    return self._reply(
+                        429, {"error": str(exc), "reason": exc.reason}
+                    )
+                except (RequestError, ValueError, TypeError) as exc:
+                    return self._reply(400, {"error": str(exc)})
+                return self._reply(202, {"id": req.id, "steps": req.steps})
+
+        return Handler

@@ -1,0 +1,243 @@
+"""Durable on-disk request queue with admission control.
+
+One JSON file per request, one directory per lifecycle state::
+
+    <root>/queued/<seq>-<id>.json     FIFO order rides the seq prefix
+    <root>/running/<id>.json          claimed by a campaign slot
+    <root>/done/<id>.json             request + result record
+    <root>/failed/<id>.json           request + terminal RequestFailed record
+
+Every transition is ``os.replace`` of a file that was fsynced at admission
+— atomic on POSIX — so a crash at ANY point leaves each request in exactly
+one state: the durability story is the filesystem's rename atomicity, not
+a database.  Restart-time :meth:`recover` re-enqueues whatever was left in
+``running/`` (the campaign that claimed it died), which is the "accepted
+requests are never lost" half of the serve contract; the scheduler's
+checkpoint + journal restore the *progress* half.
+
+Admission control is the queue's job too: :meth:`submit` rejects — with a
+typed :class:`~rustpde_mpi_tpu.serve.request.AdmissionError` naming the
+reason — once ``max_queue`` requests are waiting, so a client burst
+degrades into clean 429-style rejections instead of an OOM or an unbounded
+latency tail.  All public methods are thread-safe (the HTTP front submits
+from handler threads while the scheduler claims from the campaign loop).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+
+from .request import AdmissionError, RequestError, SimRequest
+
+_STATES = ("queued", "running", "done", "failed")
+
+
+def _atomic_write(path: str, text: str) -> None:
+    tmp = f"{path}.{os.getpid()}.tmp"
+    with open(tmp, "w", encoding="utf-8") as fh:
+        fh.write(text)
+        fh.flush()
+        os.fsync(fh.fileno())
+    os.replace(tmp, path)
+
+
+class DurableQueue:
+    """The on-disk request queue (see module docstring)."""
+
+    def __init__(self, root: str, max_queue: int = 256):
+        self.root = root
+        self.max_queue = int(max_queue)
+        self._lock = threading.RLock()
+        self._seq = 0  # in-process tiebreak under one time.time_ns() tick
+        for state in _STATES:
+            os.makedirs(os.path.join(root, state), exist_ok=True)
+
+    def _dir(self, state: str) -> str:
+        return os.path.join(self.root, state)
+
+    # -- admission ------------------------------------------------------------
+
+    def submit(self, req: SimRequest, *, admit_open: bool = True) -> SimRequest:
+        """Validate + admit one request into ``queued/``.
+
+        Raises :class:`RequestError` (malformed — never admitted) or
+        :class:`AdmissionError` (``queue_full`` backpressure, or
+        ``draining`` when the owning service flipped ``admit_open`` off).
+        Returns the request with its id/submit-time stamped."""
+        req.validate()
+        with self._lock:
+            if not admit_open:
+                raise AdmissionError(
+                    "draining", "the service is draining and admits no new work"
+                )
+            if len(self._queued_files()) >= self.max_queue:
+                raise AdmissionError(
+                    "queue_full",
+                    f"{self.max_queue} requests already queued; retry with "
+                    "backoff",
+                )
+            self._enqueue(req)
+        return req
+
+    def _enqueue(self, req: SimRequest) -> None:
+        """Write the queued file (caller holds the lock)."""
+        self._seq += 1
+        name = f"{time.time_ns():020d}{self._seq:04d}-{req.id}.json"
+        _atomic_write(os.path.join(self._dir("queued"), name), req.to_json())
+
+    def _state_files(self, state: str) -> list[str]:
+        """Committed request files only: a crash inside ``_atomic_write``
+        can leave ``*.tmp`` corpses next to them, which must never count
+        toward admission, scheduling or the lifecycle totals."""
+        try:
+            return sorted(
+                n for n in os.listdir(self._dir(state)) if n.endswith(".json")
+            )
+        except OSError:
+            return []
+
+    def _queued_files(self) -> list[str]:
+        return self._state_files("queued")
+
+    # -- scheduling -----------------------------------------------------------
+
+    def _load_queued(self) -> list[tuple[str, SimRequest]]:
+        out = []
+        for name in self._queued_files():
+            path = os.path.join(self._dir("queued"), name)
+            try:
+                with open(path, encoding="utf-8") as fh:
+                    out.append((name, SimRequest.from_json(fh.read())))
+            except (OSError, ValueError, RequestError):
+                # unreachable in practice: submit() fsyncs before the
+                # atomic rename and .tmp corpses are filtered out — but a
+                # truly unreadable file must not wedge scheduling forever
+                continue
+        return out
+
+    def buckets(self) -> dict[tuple, int]:
+        """Pending request count per compatibility bucket, FIFO-weighted:
+        the scheduler opens a campaign for the bucket holding the OLDEST
+        queued request (no starvation), refilling slots from that bucket."""
+        with self._lock:
+            counts: dict[tuple, int] = {}
+            for _, req in self._load_queued():
+                counts.setdefault(req.compat_key, 0)
+                counts[req.compat_key] += 1
+            return counts
+
+    def oldest_bucket(self) -> tuple | None:
+        with self._lock:
+            for _, req in self._load_queued():
+                return req.compat_key
+        return None
+
+    def claim(self, key: tuple | None = None) -> SimRequest | None:
+        """Atomically move the oldest queued request (matching ``key`` when
+        given) into ``running/`` and return it; None when nothing matches."""
+        with self._lock:
+            for name, req in self._load_queued():
+                if key is not None and req.compat_key != key:
+                    continue
+                src = os.path.join(self._dir("queued"), name)
+                dst = os.path.join(self._dir("running"), f"{req.id}.json")
+                os.replace(src, dst)
+                return req
+        return None
+
+    def claim_id(self, request_id: str) -> SimRequest | None:
+        """Claim one SPECIFIC queued request by id (the campaign-restore
+        path: the slot table names the request whose member state the
+        checkpoint restored).  None when the id is not queued — e.g. it
+        completed after the checkpoint was written."""
+        with self._lock:
+            for name, req in self._load_queued():
+                if req.id != request_id:
+                    continue
+                src = os.path.join(self._dir("queued"), name)
+                dst = os.path.join(self._dir("running"), f"{req.id}.json")
+                os.replace(src, dst)
+                return req
+        return None
+
+    # -- resolution -----------------------------------------------------------
+
+    def _resolve(self, req: SimRequest, state: str, record: dict) -> str:
+        with self._lock:
+            path = os.path.join(self._dir(state), f"{req.id}.json")
+            _atomic_write(path, json.dumps(record, sort_keys=True))
+            running = os.path.join(self._dir("running"), f"{req.id}.json")
+            try:
+                os.remove(running)
+            except OSError:
+                pass  # recovery may already have re-enqueued it
+            return path
+
+    def complete(self, req: SimRequest, result: dict) -> str:
+        """Move a running request to ``done/`` with its result record."""
+        return self._resolve(req, "done", {"request": json.loads(req.to_json()), "result": result})
+
+    def fail(self, req: SimRequest, reason: str) -> str:
+        """Move a running request to its terminal ``failed/`` state."""
+        record = {
+            "request": json.loads(req.to_json()),
+            "error": {"type": "RequestFailed", "reason": reason, "dts": req.dts},
+        }
+        return self._resolve(req, "failed", record)
+
+    def requeue(self, req: SimRequest) -> None:
+        """Put a running request back on the queue (drain, crash recovery,
+        or a dt-backoff retry — the caller updates the request first).
+        Requeues bypass the admission bound: the work was already
+        accepted."""
+        with self._lock:
+            self._enqueue(req)
+            running = os.path.join(self._dir("running"), f"{req.id}.json")
+            try:
+                os.remove(running)
+            except OSError:
+                pass
+
+    def recover(self) -> list[str]:
+        """Re-enqueue every ``running/`` request (startup: whatever claimed
+        them died before resolving).  Progress is NOT reset here — the
+        scheduler restores it from the campaign checkpoint when it can.
+        Returns the recovered ids."""
+        recovered = []
+        with self._lock:
+            for name in self._state_files("running"):
+                path = os.path.join(self._dir("running"), name)
+                try:
+                    with open(path, encoding="utf-8") as fh:
+                        req = SimRequest.from_json(fh.read())
+                except (OSError, ValueError, RequestError):
+                    continue
+                self._enqueue(req)
+                os.remove(path)
+                recovered.append(req.id)
+        return recovered
+
+    # -- introspection --------------------------------------------------------
+
+    def counts(self) -> dict[str, int]:
+        with self._lock:
+            return {
+                state: len(self._state_files(state)) for state in _STATES
+            }
+
+    def lookup(self, request_id: str) -> tuple[str, dict] | None:
+        """(state, record) for one id; queued records are the bare request."""
+        with self._lock:
+            for state in ("running", "done", "failed"):
+                path = os.path.join(self._dir(state), f"{request_id}.json")
+                if os.path.exists(path):
+                    with open(path, encoding="utf-8") as fh:
+                        data = json.load(fh)
+                    return state, (data if state != "running" else {"request": data})
+            for name, req in self._load_queued():
+                if req.id == request_id:
+                    return "queued", {"request": json.loads(req.to_json())}
+        return None

@@ -1,0 +1,169 @@
+"""Simulation requests: the unit of work the service admits, batches,
+retries and resolves.
+
+A :class:`SimRequest` names everything a campaign needs to reproduce the
+run — grid, physics parameters, dt, geometry, horizon, IC seed — plus the
+bookkeeping the robustness contract rides on (retry budget and count, dt
+trajectory, progress at the last drain).  Its :meth:`compat_key` mirrors
+:attr:`~rustpde_mpi_tpu.models.navier.Navier2D.compat_key`: requests with
+equal keys share one compiled ensemble step and can co-batch / refill each
+other's slots without recompiling.
+
+Lifecycle (the queue directories in serve/queue.py map 1:1)::
+
+    queued ── claim ──> running ── complete ──> done
+      ^                   │ │
+      │   requeue (drain/ │ └─ fail (retries exhausted) ──> failed
+      └── crash/dt-retry)─┘
+
+Every transition is an atomic file rename, so a crash at any point leaves
+each request in exactly one state and restart-time recovery re-enqueues
+whatever was ``running`` — accepted requests are never lost.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import time
+import uuid
+
+
+class RequestError(ValueError):
+    """A submitted request is malformed (bad grid/dt/horizon/bc): rejected
+    at admission, before it can poison a batch."""
+
+
+class AdmissionError(RuntimeError):
+    """The service refused to admit a request — bounded-queue backpressure
+    (``reason="queue_full"``) or a draining/stopped service
+    (``reason="draining"``).  Typed reject-with-reason instead of an
+    unbounded backlog: the client backs off or routes elsewhere."""
+
+    def __init__(self, reason: str, detail: str):
+        super().__init__(f"request rejected ({reason}): {detail}")
+        self.reason = reason
+
+
+class RequestFailed(RuntimeError):
+    """Terminal per-request failure: the request diverged (or was killed)
+    and exhausted its retry budget.  Carries the request id, the journaled
+    dt trajectory it was retried along, and the terminal reason — the
+    per-request analogue of
+    :class:`~rustpde_mpi_tpu.utils.resilience.DivergenceError`."""
+
+    def __init__(self, request_id: str, reason: str, dt_trajectory=()):
+        super().__init__(
+            f"request {request_id} failed terminally ({reason}); "
+            f"dt trajectory: {list(dt_trajectory)}"
+        )
+        self.request_id = request_id
+        self.reason = reason
+        self.dt_trajectory = list(dt_trajectory)
+
+
+@dataclasses.dataclass
+class SimRequest:
+    """One simulation request (Ra/Pr/resolution/geometry/horizon).
+
+    ``horizon`` is sim-time; the scheduler converts it to a step count at
+    admission (``steps = max(1, round(horizon / dt))``).  ``dt`` may be
+    rewritten by the per-request divergence retry (backoff re-queues the
+    request at a smaller dt — a different compatibility bucket); ``dts``
+    records the trajectory for the terminal :class:`RequestFailed` report.
+    ``progress`` carries steps already completed in a drained campaign
+    whose checkpoint will restore the member state on resume."""
+
+    ra: float
+    horizon: float
+    pr: float = 1.0
+    nx: int = 129
+    ny: int = 129
+    dt: float = 2e-3
+    aspect: float = 1.0
+    bc: str = "rbc"
+    periodic: bool = False
+    seed: int = 0
+    amp: float | None = None  # IC amplitude (None: ServeConfig.default_amp)
+    id: str = ""
+    submitted_s: float = 0.0  # unix time at admission (latency accounting)
+    retries: int = 0  # divergence retries consumed
+    dts: list = dataclasses.field(default_factory=list)  # dt trajectory
+    progress: int = 0  # steps completed before the last drain/requeue
+
+    def __post_init__(self):
+        if not self.id:
+            self.id = uuid.uuid4().hex[:12]
+        if not self.submitted_s:
+            self.submitted_s = time.time()
+        if not self.dts:
+            self.dts = [float(self.dt)]
+
+    def validate(self) -> "SimRequest":
+        """Admission-time sanity: reject malformed work before it costs a
+        compile or poisons a batch.  Raises :class:`RequestError`."""
+        if self.bc not in ("rbc", "hc"):
+            raise RequestError(f"bc must be 'rbc' or 'hc', got {self.bc!r}")
+        if not (self.nx >= 4 and self.ny >= 4):
+            raise RequestError(f"grid too small: {self.nx}x{self.ny}")
+        if not (self.dt > 0.0):
+            raise RequestError(f"dt must be positive, got {self.dt}")
+        if not (self.horizon > 0.0):
+            raise RequestError(f"horizon must be positive, got {self.horizon}")
+        if not (self.ra > 0.0 and self.pr > 0.0):
+            raise RequestError(f"Ra/Pr must be positive, got {self.ra}/{self.pr}")
+        return self
+
+    @property
+    def compat_key(self) -> tuple:
+        """Operator-constant bucket key — equal keys co-batch (see
+        :attr:`Navier2D.compat_key`; same field order)."""
+        return (
+            int(self.nx),
+            int(self.ny),
+            float(self.ra),
+            float(self.pr),
+            float(self.dt),
+            float(self.aspect),
+            str(self.bc),
+            bool(self.periodic),
+        )
+
+    @property
+    def steps(self) -> int:
+        """Total steps this request needs at its current dt."""
+        return max(1, round(float(self.horizon) / float(self.dt)))
+
+    @property
+    def steps_remaining(self) -> int:
+        """Steps still owed after any drained-campaign progress."""
+        return max(0, self.steps - int(self.progress))
+
+    def backed_off(self, factor: float) -> "SimRequest":
+        """The retry copy: dt shrunk, retry counted, progress DISCARDED —
+        a diverged trajectory is not worth resuming — and the dt recorded
+        on the trajectory."""
+        new_dt = float(self.dt) * float(factor)
+        return dataclasses.replace(
+            self,
+            dt=new_dt,
+            retries=self.retries + 1,
+            dts=self.dts + [new_dt],
+            progress=0,
+        )
+
+    def to_json(self) -> str:
+        return json.dumps(dataclasses.asdict(self), sort_keys=True)
+
+    @classmethod
+    def from_json(cls, text: str) -> "SimRequest":
+        data = json.loads(text)
+        known = {f.name for f in dataclasses.fields(cls)}
+        unknown = set(data) - known
+        if unknown:
+            raise RequestError(f"unknown request fields: {sorted(unknown)}")
+        return cls(**data)
+
+    @classmethod
+    def from_dict(cls, data: dict) -> "SimRequest":
+        return cls.from_json(json.dumps(data))

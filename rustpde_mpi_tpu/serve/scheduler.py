@@ -1,0 +1,674 @@
+"""Continuously-batched ensemble scheduler: the fault-isolated simulation
+service.
+
+The serving layer the ROADMAP's multi-tenant north star needs: a
+persistent driver that accepts :class:`~.request.SimRequest` work through
+the durable queue (serve/queue.py, plus the thin HTTP front in
+serve/http_front.py), bucket-batches compatible requests into
+:class:`~rustpde_mpi_tpu.models.ensemble.NavierEnsemble` slots, and
+streams per-request observables back through PR-4 observable futures as
+each request resolves.  The batching is LLM-style CONTINUOUS batching:
+
+* requests bucket by :attr:`SimRequest.compat_key` (the operator constants
+  one compiled vmapped step can serve: grid, Ra/Pr, dt, geometry, BC),
+* a campaign opens one K-slot ensemble per bucket; each chunk advances
+  every running slot together as ONE donated vmapped dispatch,
+* the chunk length is ``min(remaining steps of any running slot,
+  chunk_steps)``, so completions land exactly on chunk boundaries,
+* a finished, diverged or idle slot is REFILLED from the queue at the
+  boundary via ``set_member`` — the existing respawn machinery — without
+  recompiling anything (equal keys share the jaxpr by construction).
+
+Robustness is the spec, not a bolt-on:
+
+* **per-request fault isolation** — one member's NaN freezes that member
+  only (the ensemble's per-member finite mask); co-batched requests keep
+  stepping bit-exactly like their solo runs (CI-asserted),
+* **per-request retry** — a diverged request is re-queued at
+  ``dt * request_dt_backoff`` (a different bucket: dt is an operator
+  constant) with a bounded budget, then lands in the typed
+  :class:`~.request.RequestFailed` terminal state,
+* **admission control** — the queue bounds admissions and a submit past
+  the bound is rejected with a reason (queue.py),
+* **graceful drain** — SIGTERM (or :meth:`SimServer.request_drain`)
+  finishes the in-flight chunk, checkpoints every slot via the sharded
+  two-phase writer — WITH the slot table riding the manifest as
+  digest-covered root data — re-enqueues unfinished requests and exits
+  clean,
+* **crash recovery** — on restart the queue re-enqueues whatever was
+  ``running`` (accepted requests are never lost) and the campaign restore
+  rebuilds the slot table from the newest valid checkpoint, so drained or
+  killed requests resume mid-trajectory instead of restarting.
+
+The device-facing machinery is the embedded
+:class:`~rustpde_mpi_tpu.utils.resilience.ResilientRunner` (its
+``session``/``advance``/``checkpoint_now`` surface): fault injection,
+dispatch watchdogs, the async/sharded checkpoint pipeline and the journal
+all come from there — the service adds scheduling, not a second harness.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import os
+import signal
+import time
+
+import numpy as np
+
+from ..config import IOConfig, ServeConfig
+from ..models.ensemble import NavierEnsemble
+from ..models.navier import Navier2D
+from ..utils import checkpoint
+from ..utils.faults import FaultPlan, validate_fault_env
+from ..utils.journal import JournalWriter, read_journal
+from ..utils.resilience import ResilientRunner
+from .queue import DurableQueue
+from .request import RequestFailed, SimRequest
+
+
+class _ServedEnsemble(NavierEnsemble):
+    """Ensemble whose checkpoints are self-describing for the scheduler:
+    ``serve_meta`` (one dict per slot: request json + step target, None =
+    idle) rides the sharded manifest as digest-covered root data, so a
+    restore rebuilds the slot table from the checkpoint alone — no side
+    file that could go stale against the state it describes."""
+
+    def __init__(self, model, states):
+        super().__init__(model, states)
+        self.serve_meta: list[dict | None] = [None] * self.k
+        self.restored_meta: list[dict | None] | None = None
+
+    def snapshot_root_items(self) -> list:
+        items = super().snapshot_root_items()
+        blob = np.frombuffer(
+            json.dumps(self.serve_meta).encode("utf-8"), np.uint8
+        ).copy()
+        items.append(("serve_slots", blob, "raw"))
+        return items
+
+    def apply_restored_state(self, updates, attrs, root) -> None:
+        super().apply_restored_state(updates, attrs, root)
+        if "serve_slots" in root:
+            meta = json.loads(bytes(np.asarray(root["serve_slots"])).decode("utf-8"))
+            self.serve_meta = meta
+            self.restored_meta = meta
+
+
+@dataclasses.dataclass
+class _Slot:
+    """One ensemble lane: IDLE (masked dead, waiting for work) or RUNNING
+    a request toward ``target`` member-steps (``steps_done`` measured by
+    the ensemble's own per-member counter)."""
+
+    index: int
+    req: SimRequest | None = None
+    target: int = 0
+
+    @property
+    def running(self) -> bool:
+        return self.req is not None
+
+
+class SimServer:
+    """The service front: durable queue + continuous-batching scheduler.
+
+    Batch mode (``cfg.idle_exit=True``, the default) drains the queue and
+    returns a summary; daemon mode keeps polling for new work (the HTTP
+    front feeds the queue concurrently) until :meth:`request_drain` or
+    SIGTERM.  One instance per process — it installs signal handlers while
+    :meth:`serve` runs."""
+
+    def __init__(self, cfg: ServeConfig | None = None, *, fault: str | None = None):
+        self.cfg = cfg or ServeConfig()
+        validate_fault_env()  # malformed chaos specs die here, not silently
+        self.queue = DurableQueue(
+            os.path.join(self.cfg.run_dir, "queue"), max_queue=self.cfg.max_queue
+        )
+        self.journal_path = os.path.join(self.cfg.run_dir, "journal.jsonl")
+        self._journal_writer = JournalWriter(self.journal_path)
+        self._fault = FaultPlan.from_spec(
+            fault if fault is not None else os.environ.get("RUSTPDE_FAULT")
+        )
+        self._drain = False
+        self._runner: ResilientRunner | None = None
+        self._t0 = time.monotonic()
+        self._global_step = 0  # member-chunk steps across campaigns
+        self._member_steps = 0  # aggregate member-steps actually computed
+        self._completed = 0
+        self._failed = 0
+        self._retried = 0
+        self._pending_results: list[tuple] = []  # (obs_future, [(slot,req,..)])
+        self._prev_handlers: dict = {}
+        self._http = None
+
+    # -- client surface -------------------------------------------------------
+
+    def submit(self, req: SimRequest | dict) -> SimRequest:
+        """Admit one request (validation + bounded-queue admission control;
+        raises RequestError / AdmissionError).  Thread-safe — the HTTP
+        front calls this from handler threads."""
+        if isinstance(req, dict):
+            req = SimRequest.from_dict(req)
+        elif not isinstance(req, SimRequest):
+            from .request import RequestError
+
+            raise RequestError(
+                f"request must be a dict or SimRequest, got {type(req).__name__}"
+            )
+        if req.amp is None:
+            req.amp = float(self.cfg.default_amp)
+        self.queue.submit(req, admit_open=not self._drain)
+        self._journal(
+            {
+                "event": "request_admitted",
+                "id": req.id,
+                "key": list(req.compat_key),
+                "steps": req.steps,
+                "queued": self.queue.counts()["queued"],
+            }
+        )
+        return req
+
+    def status(self, request_id: str) -> dict | None:
+        """Lifecycle state + record for one request id (None: unknown)."""
+        found = self.queue.lookup(request_id)
+        if found is None:
+            return None
+        state, record = found
+        return {"id": request_id, "state": state, **record}
+
+    def result(self, request_id: str) -> dict | None:
+        """A done request's result record; raises the typed
+        :class:`RequestFailed` for a terminally failed one; None while the
+        request is still queued/running."""
+        found = self.queue.lookup(request_id)
+        if found is None:
+            raise KeyError(f"unknown request id {request_id!r}")
+        state, record = found
+        if state == "done":
+            return record["result"]
+        if state == "failed":
+            err = record["error"]
+            raise RequestFailed(request_id, err["reason"], err.get("dts", ()))
+        return None
+
+    def request_drain(self) -> None:
+        """Ask the service to drain: stop admitting, checkpoint in-flight
+        slots, re-enqueue unfinished requests, return from serve()."""
+        self._drain = True
+        runner = self._runner
+        if runner is not None:
+            runner.request_drain()
+
+    def stats(self) -> dict:
+        return {
+            "queue": self.queue.counts(),
+            "completed": self._completed,
+            "failed": self._failed,
+            "retried": self._retried,
+            "member_steps": self._member_steps,
+            "wall_s": round(time.monotonic() - self._t0, 3),
+            "draining": self._drain,
+        }
+
+    # -- service loop ---------------------------------------------------------
+
+    def serve(self) -> dict:
+        """Run the service until the queue drains (batch mode), or until a
+        drain is requested (daemon mode).  Returns a summary dict."""
+        self._install_signals()
+        self._start_http()
+        unclean = self._detect_unclean_shutdown()
+        recovered = self.queue.recover()
+        self._journal(
+            {
+                "event": "server_start",
+                "slots": self.cfg.slots,
+                "max_queue": self.cfg.max_queue,
+                "recovered": recovered,
+                "unclean_shutdown": unclean,
+                "fault": dataclasses.asdict(self._fault) if self._fault else None,
+            }
+        )
+        try:
+            while not self._drain:
+                key = self.queue.oldest_bucket()
+                if key is None:
+                    if self.cfg.idle_exit:
+                        break
+                    time.sleep(self.cfg.poll_s)
+                    continue
+                self._run_campaign(key)
+            if self._drain:
+                self._journal({"event": "drain"})
+        finally:
+            import sys as _sys
+
+            if _sys.exc_info()[0] is None:
+                self._flush_results(force=True)
+            elif self._pending_results:
+                # an exception (DispatchHang above all) is propagating:
+                # forcing the pending observable futures would device_get
+                # against a possibly-wedged runtime with no watchdog and eat
+                # the structured raise — drop them instead; the requests
+                # stay claimed and queue.recover() re-runs them on restart
+                self._journal(
+                    {
+                        "event": "results_abandoned",
+                        "batches": len(self._pending_results),
+                    }
+                )
+                self._pending_results = []
+            summary = {
+                "outcome": "drained" if self._drain else "idle",
+                **self.stats(),
+                "journal": self.journal_path,
+            }
+            self._journal({"event": "server_stop", **summary})
+            self._journal_writer.close()  # reopens lazily if used again
+            self._stop_http()
+            self._restore_signals()
+        return summary
+
+    def _detect_unclean_shutdown(self) -> bool:
+        """True when the previous incarnation died without a server_stop —
+        read through the torn-tail-tolerant reader, since the very crash
+        being detected may have torn the final journal line."""
+        events = [
+            r.get("event")
+            for r in read_journal(self.journal_path, on_error="skip")
+            if r.get("event") in ("server_start", "server_stop")
+        ]
+        return bool(events) and events[-1] != "server_stop"
+
+    # -- signals / http -------------------------------------------------------
+
+    def _install_signals(self) -> None:
+        def handler(signum, frame):
+            self.request_drain()
+
+        try:
+            for sig in (signal.SIGTERM, signal.SIGINT):
+                self._prev_handlers[sig] = signal.signal(sig, handler)
+        except ValueError:  # not the main thread
+            self._prev_handlers = {}
+
+    def _restore_signals(self) -> None:
+        for sig, prev in self._prev_handlers.items():
+            signal.signal(sig, prev)
+        self._prev_handlers = {}
+
+    def _start_http(self) -> None:
+        if self.cfg.http_port is None:
+            return
+        from .http_front import HttpFront
+
+        self._http = HttpFront(self, self.cfg.http_host, self.cfg.http_port)
+        self._http.start()
+        self._journal({"event": "http_listen", "address": self._http.address})
+
+    def _stop_http(self) -> None:
+        if self._http is not None:
+            self._http.stop()
+            self._http = None
+
+    @property
+    def http_address(self) -> tuple[str, int] | None:
+        return self._http.address if self._http is not None else None
+
+    # -- journal --------------------------------------------------------------
+
+    def _journal(self, event: dict) -> None:
+        self._journal_writer.append(
+            {"wall_s": round(time.monotonic() - self._t0, 3), **event}
+        )
+
+    # -- campaign -------------------------------------------------------------
+
+    def _campaign_dir(self, key: tuple) -> str:
+        tag = hashlib.sha1(repr(key).encode()).hexdigest()[:12]
+        return os.path.join(self.cfg.run_dir, "campaigns", tag)
+
+    def _build_runner(self, key: tuple) -> tuple[ResilientRunner, _ServedEnsemble]:
+        nx, ny, ra, pr, dt, aspect, bc, periodic = key
+        model = Navier2D(nx, ny, ra, pr, dt, aspect, bc, periodic=periodic)
+        model.write_intervall = float("inf")  # no flow-file callback IO
+        ens = _ServedEnsemble(model, [model.state] * int(self.cfg.slots))
+        ens.mark_dead(range(ens.k))  # all lanes idle until a request lands
+        rcfg = self.cfg.resilience
+        runner = ResilientRunner.from_config(
+            ens,
+            rcfg,
+            max_time=float("inf"),
+            save_intervall=None,
+            run_dir=self._campaign_dir(key),
+            checkpoint_every_s=self.cfg.checkpoint_every_s,
+            # divergence policy is PER REQUEST here (backoff re-queue);
+            # whole-campaign checkpoint rollback stays the reactive last
+            # resort behind it
+            max_retries=getattr(rcfg, "max_retries", 3) if rcfg else 3,
+            # serve checkpoints must carry the slot table in a manifest:
+            # force the sharded two-phase format (single- or multi-process)
+            io=IOConfig(sharded_checkpoints=True, overlap_dispatch=False),
+            fault="",  # the server owns ONE plan across campaigns (below)
+            # NO governor inside a campaign: its batch-wide set_dt would
+            # silently rewrite every co-batched request's dt (dt is part of
+            # the request contract AND the bucket key) — the per-request
+            # dt-backoff retry is the serve-layer stability policy
+            stability=None,
+        )
+        runner.fault = self._fault
+        runner.step = self._global_step
+        runner.set_journal(self._journal_writer)
+        return runner, ens
+
+    def _run_campaign(self, key: tuple) -> None:
+        runner, ens = self._build_runner(key)
+        self._runner = runner
+        if self._drain:  # a signal raced the build
+            runner.request_drain()
+        try:
+            with runner.session(install_signals=False, resume=False):
+                self._try_resume(runner)
+                slots = self._restore_slots(runner, ens, key)
+                self._journal(
+                    {
+                        "event": "campaign_start",
+                        "key": list(key),
+                        "dir": runner.run_dir,
+                        "restored": runner.resumed,
+                        "slots_restored": sum(1 for s in slots if s.running),
+                    }
+                )
+                self._fill_slots(runner, ens, slots, key)
+                self._campaign_loop(runner, ens, slots, key)
+        finally:
+            self._global_step = runner.step
+            self._runner = None
+
+    def _try_resume(self, runner) -> None:
+        """Campaign restore with graceful degradation: a checkpoint that no
+        longer fits (slot-count/config change between incarnations — the
+        sharded format is K-fixed) must NOT brick the service.  The
+        incompatible checkpoints are swept (their slot geometry can never
+        be restored by this server) and the campaign starts fresh — every
+        request is still durably queued, so nothing is lost, only the
+        drained progress."""
+        try:
+            runner.resumed = runner._maybe_resume()
+        except checkpoint.CheckpointError as exc:
+            self._journal(
+                {
+                    "event": "campaign_restore_failed",
+                    "dir": runner.run_dir,
+                    "error": str(exc),
+                }
+            )
+            for path in checkpoint.checkpoint_files(runner.run_dir):
+                checkpoint.remove_checkpoint(path)
+            runner.resumed = False
+            runner._last_ckpt_path = None
+
+    def _restore_slots(self, runner, ens, key: tuple) -> list[_Slot]:
+        """Rebuild the slot table after a checkpoint restore: a restored
+        slot whose request is back in the queue (drain re-enqueued it, or
+        crash recovery did) is RE-CLAIMED into its old lane — the member
+        state is already sitting there, bit-equal — and continues from its
+        checkpointed step counter.  Restored slots whose request is gone
+        (completed after the checkpoint, durably recorded) go idle."""
+        slots = [_Slot(i) for i in range(ens.k)]
+        meta = ens.restored_meta if runner.resumed else None
+        if not meta:
+            return slots
+        alive = ens.alive()
+        for i, m in enumerate(meta[: ens.k]):
+            if not m:
+                continue
+            if not alive[i]:
+                # the member was dead in the checkpoint: leave the request
+                # queued — a fresh lane (fresh IC) will claim it instead of
+                # resuming a doomed trajectory
+                ens.serve_meta[i] = None
+                continue
+            req = self.queue.claim_id(m["id"])
+            if req is None:
+                # the request resolved after this checkpoint was written
+                # (durably recorded in done/): lane reverts to idle
+                ens.serve_meta[i] = None
+                ens.mark_dead([i])
+                continue
+            if req.compat_key != key:
+                # same id, DIFFERENT bucket: the request diverged after this
+                # checkpoint and was re-queued backed off to a new dt — the
+                # old-dt member state must not resume it (the consumed retry
+                # would never apply the backoff).  Leave it for its new
+                # bucket's campaign.
+                self.queue.requeue(req)
+                ens.serve_meta[i] = None
+                ens.mark_dead([i])
+                continue
+            slots[i].req = req
+            slots[i].target = int(m["target"])
+            self._journal(
+                {
+                    "event": "request_scheduled",
+                    "id": req.id,
+                    "slot": i,
+                    "target": slots[i].target,
+                    "restored": True,
+                    "steps_done": int(np.asarray(ens.steps_done)[i]),
+                }
+            )
+        return slots
+
+    def _fill_slots(self, runner, ens, slots: list[_Slot], key: tuple) -> None:
+        """Refill every idle lane from this bucket's queue (fresh IC via
+        the template model's generator; ``set_member`` installs it without
+        recompiling)."""
+        if self._drain:
+            return
+        for slot in slots:
+            if slot.running:
+                continue
+            req = self.queue.claim(key)
+            if req is None:
+                return
+            state = ens.fresh_member_state(req.seed, req.amp or self.cfg.default_amp)
+            ens.set_member(slot.index, state)
+            slot.req = req
+            slot.target = req.steps
+            ens.serve_meta[slot.index] = {"id": req.id, "target": slot.target,
+                                          "req": json.loads(req.to_json())}
+            self._journal(
+                {
+                    "event": "request_scheduled",
+                    "id": req.id,
+                    "slot": slot.index,
+                    "target": slot.target,
+                    "restored": False,
+                    "step": runner.step,
+                }
+            )
+
+    def _campaign_loop(self, runner, ens, slots: list[_Slot], key: tuple) -> None:
+        while True:
+            running = [s for s in slots if s.running]
+            if not running:
+                break
+            done = np.asarray(ens.steps_done)
+            n = min(
+                min(s.target - int(done[s.index]) for s in running),
+                int(self.cfg.chunk_steps),
+            )
+            n = max(1, n)
+            before = runner.step
+            runner.advance(n)
+            advanced = runner.step - before
+            self._member_steps += advanced * len(running)
+            self._settle_boundary(runner, ens, slots, key)
+            # boundary housekeeping: deferred sharded commit + cadence
+            # checkpoint + the drain/preemption flag — runner.on_boundary is
+            # the same hook integrate() would drive
+            if runner.on_boundary() or self._drain:
+                self._drain = True
+                self._drain_campaign(runner, ens, slots)
+                return
+            self._fill_slots(runner, ens, slots, key)
+            self._flush_results()
+        self._flush_results(force=True)
+        self._journal({"event": "campaign_end", "key": list(key),
+                       "step": runner.step})
+        # a cleanly finished campaign leaves no work to restore: settle the
+        # async writer FIRST (a background shard write must never race the
+        # sweep), then remove its checkpoints so a LATER campaign in this
+        # bucket starts fresh instead of restoring a stale slot table
+        runner._drain_io()
+        for path in checkpoint.checkpoint_files(runner.run_dir):
+            checkpoint.remove_checkpoint(path)
+
+    def _settle_boundary(self, runner, ens, slots: list[_Slot], key: tuple) -> None:
+        """Process completions and deaths at a chunk boundary.  The
+        observables for every slot that finished here ride ONE vmapped
+        async dispatch (PR-4 futures) captured BEFORE any lane is refilled,
+        so the fetched values are the finished members' final states."""
+        alive = ens.alive()
+        done = np.asarray(ens.steps_done)
+        finished = [
+            s for s in slots
+            if s.running and alive[s.index] and int(done[s.index]) >= s.target
+        ]
+        dead = [s for s in slots if s.running and not alive[s.index]]
+        if finished:
+            obs_fut = ens.get_observables_async()
+            batch = []
+            for s in finished:
+                batch.append(
+                    {
+                        "slot": s.index,
+                        "req": s.req,
+                        "steps": int(done[s.index]),
+                        "finished_wall": time.time(),
+                        "step": runner.step,
+                    }
+                )
+                self._release(ens, s)
+            self._pending_results.append((obs_fut, batch))
+        for s in dead:
+            self._handle_death(runner, ens, s, int(done[s.index]))
+
+    def _release(self, ens, slot: _Slot) -> None:
+        """Lane back to idle (masked dead until refilled)."""
+        ens.serve_meta[slot.index] = None
+        ens.mark_dead([slot.index])
+        slot.req = None
+        slot.target = 0
+
+    def _handle_death(self, runner, ens, slot: _Slot, steps_done: int) -> None:
+        """Per-request divergence policy: bounded dt-backoff retry, then
+        the typed terminal state.  The lane itself is immediately reusable
+        — one member's NaN never perturbs its co-batched neighbours."""
+        req = slot.req
+        self._release(ens, slot)
+        if req.retries < self.cfg.request_max_retries:
+            retry = req.backed_off(self.cfg.request_dt_backoff)
+            self.queue.requeue(retry)
+            self._retried += 1
+            self._journal(
+                {
+                    "event": "request_retry",
+                    "id": req.id,
+                    "slot": slot.index,
+                    "steps_done": steps_done,
+                    "dt": retry.dt,
+                    "retries": retry.retries,
+                }
+            )
+        else:
+            reason = (
+                f"diverged at member-step {steps_done}/{req.steps} and "
+                f"exhausted {self.cfg.request_max_retries} retries"
+            )
+            self.queue.fail(req, reason)
+            self._failed += 1
+            self._journal(
+                {
+                    "event": "request_failed",
+                    "id": req.id,
+                    "slot": slot.index,
+                    "reason": reason,
+                    "dts": req.dts,
+                }
+            )
+
+    def _flush_results(self, force: bool = False) -> None:
+        """Resolve finished-request observable futures and write the done
+        records.  Non-blocking by default (a future still in flight stays
+        pending — the stream, not the device, waits); ``force`` resolves
+        everything (campaign end / server stop)."""
+        keep = []
+        for fut, batch in self._pending_results:
+            if not force and not fut.ready():
+                keep.append((fut, batch))
+                continue
+            nu, nuvol, re, div = fut.result()
+            for item in batch:
+                req: SimRequest = item["req"]
+                i = item["slot"]
+                result = {
+                    "nu": float(nu[i]),
+                    "nuvol": float(nuvol[i]),
+                    "re": float(re[i]),
+                    "div": float(div[i]),
+                    "steps": item["steps"],
+                    "dt": float(req.dt),
+                    "seed": int(req.seed),
+                    # IC amplitude rides the record so solo-equivalence
+                    # checks rerun the exact trajectory
+                    "amp": float(req.amp) if req.amp else None,
+                    "retries": int(req.retries),
+                    "slot": i,
+                    "latency_s": round(item["finished_wall"] - req.submitted_s, 6),
+                }
+                self.queue.complete(req, result)
+                self._completed += 1
+                self._journal(
+                    {
+                        "event": "request_done",
+                        "id": req.id,
+                        "slot": i,
+                        "steps": item["steps"],
+                        "nu": result["nu"],
+                        "latency_s": result["latency_s"],
+                        "step": item["step"],
+                    }
+                )
+        self._pending_results = keep
+
+    def _drain_campaign(self, runner, ens, slots: list[_Slot]) -> None:
+        """The graceful-drain path: flush resolved results, checkpoint the
+        slot table + member states through the sharded two-phase writer,
+        then re-enqueue every unfinished request (progress stamped for the
+        record; the checkpoint is what actually restores it)."""
+        self._flush_results(force=True)
+        running = [s for s in slots if s.running]
+        path = None
+        if running:
+            path = runner.checkpoint_now("drain")
+        done = np.asarray(ens.steps_done)
+        for s in running:
+            req = dataclasses.replace(s.req, progress=int(done[s.index]))
+            self.queue.requeue(req)
+            self._journal(
+                {
+                    "event": "request_requeued",
+                    "id": req.id,
+                    "slot": s.index,
+                    "progress": req.progress,
+                    "target": s.target,
+                    "checkpoint": path,
+                }
+            )
+        runner._drain_io()

@@ -837,20 +837,22 @@ def _shard_crash_hook(point: str, step) -> None:
       between shard fsync and manifest commit" window,
     * ``before_manifest`` — root passed the barrier + digest exchange but
       has not written the manifest: the commit marker is missing even
-      though EVERY shard landed."""
-    spec = os.environ.get("RUSTPDE_SHARD_CRASH")
-    if not spec or step is None:
+      though EVERY shard landed.
+
+    Parsing is STRICT (utils/faults.parse_shard_crash_spec): a malformed
+    spec raises a typed FaultSpecError rather than silently never firing —
+    a chaos test that isn't injecting is worse than none.  The harness
+    constructors validate the env at startup too (faults.validate_fault_env),
+    so the raise normally lands before any stepping."""
+    from .faults import parse_shard_crash_spec
+
+    plan = parse_shard_crash_spec(os.environ.get("RUSTPDE_SHARD_CRASH"))
+    if plan is None or step is None:
         return
-    want, sep, rest = spec.partition("@")
-    if not sep or want != point:
+    want, at, host = plan
+    if want != point or at != int(step):
         return
-    at, _, host = rest.partition(":")
-    try:
-        if int(at) != int(step):
-            return
-    except ValueError:
-        return
-    if host and _process_index() != int(host.removeprefix("host")):
+    if host is not None and _process_index() != host:
         return
     os._exit(9)
 

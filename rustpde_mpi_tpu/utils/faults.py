@@ -1,0 +1,154 @@
+"""Deterministic fault-injection spec parsing (STRICT).
+
+``RUSTPDE_FAULT`` and ``RUSTPDE_SHARD_CRASH`` drive every chaos test and
+soak gate in the repo.  A malformed spec that silently injects *nothing* is
+worse than no spec at all — the chaos run goes green while testing the
+happy path — so every parse error here raises a typed
+:class:`FaultSpecError` naming the spec and the expected grammar, and the
+consumers (:class:`~rustpde_mpi_tpu.utils.resilience.ResilientRunner`,
+``serve.SimServer``) validate the environment at STARTUP via
+:func:`validate_fault_env`, before any stepping happens.
+
+This module is import-light on purpose (no jax): utils/checkpoint.py calls
+into it from inside the two-phase commit window.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+
+FAULT_KINDS = ("nan", "spike", "kill", "slow")
+SHARD_CRASH_POINTS = ("after_shard", "before_manifest")
+
+
+class FaultSpecError(ValueError):
+    """A fault-injection spec (``RUSTPDE_FAULT`` / ``RUSTPDE_SHARD_CRASH``)
+    failed to parse.  Subclasses ValueError so legacy callers catching that
+    keep working; raised at startup so a chaos run that would silently
+    inject nothing dies loudly instead."""
+
+    def __init__(self, spec: str, expected: str, detail: str = ""):
+        msg = f"bad fault spec {spec!r}: expected {expected}"
+        if detail:
+            msg += f" ({detail})"
+        super().__init__(msg)
+        self.spec = spec
+
+
+def _parse_host_scope(token: str, spec: str, expected: str) -> int:
+    if not token.startswith("host") or not token[4:].isdigit():
+        raise FaultSpecError(
+            spec, expected, f"bad host scope {token!r}, expected host<p>"
+        )
+    return int(token[4:])
+
+
+@dataclasses.dataclass
+class FaultPlan:
+    """Parsed ``RUSTPDE_FAULT`` spec ``<kind>@<step>[:host<p>]``: inject
+    ``kind`` once when the run's global step counter reaches ``step``,
+    optionally scoped to ONE process of a multihost job (``host`` = process
+    index; every host still *fires* the plan at the same step so collective
+    dispatch stays aligned — only the scoped host acts).
+
+    * ``nan``   — poison the state (every recovery path downstream of the
+      model's NaN break criterion); host-scoped, only the columns owned by
+      that host's devices are poisoned (a single-host fault that then
+      propagates through the collective step, the realistic multihost
+      divergence shape),
+    * ``spike`` — scale the velocity fields by ``spike_factor`` on-device:
+      the state stays *finite* but its CFL number blows past the sentinel
+      ceiling, so this exercises the stability governor's pre-divergence
+      catch + in-memory rollback + dt-ladder descent/regrowth — and, on an
+      ungoverned run, the incipient-blow-up-to-NaN path; host-scoped like
+      ``nan``,
+    * ``kill``  — SIGTERM this process (the preemption path).  HOST-SCOPED
+      kill is a hard ``SIGKILL`` instead: one host of a multihost job dying
+      without ceremony (the surviving hosts hit the next collective and
+      need ``RUSTPDE_SYNC_TIMEOUT_S`` to convert the wedge into a
+      structured ``DispatchHang``),
+    * ``slow``  — stall the next dispatch past the watchdog deadline (the
+      ``DispatchHang`` path); host-scoped, only that host stalls.
+
+    The two-phase checkpoint WINDOW faults (kill between shard fsync and
+    manifest commit) are a separate hook — ``RUSTPDE_SHARD_CRASH``, parsed
+    by :func:`parse_shard_crash_spec` — because they key on a phase of the
+    commit protocol, not a step count."""
+
+    kind: str
+    step: int
+    host: int | None = None
+    fired: bool = False
+
+    KINDS = FAULT_KINDS
+    EXPECTED = "<nan|spike|kill|slow>@<step>[:host<p>]"
+
+    @classmethod
+    def from_spec(cls, spec: str | None) -> "FaultPlan | None":
+        if not spec:
+            return None
+        kind, sep, rest = spec.partition("@")
+        at, hsep, host = rest.partition(":")
+        if kind not in cls.KINDS or not sep:
+            raise FaultSpecError(spec, cls.EXPECTED, f"unknown kind {kind!r}")
+        try:
+            step = int(at)
+        except ValueError:
+            raise FaultSpecError(
+                spec, cls.EXPECTED, f"bad step {at!r}, expected an integer"
+            ) from None
+        return cls(
+            kind=kind,
+            step=step,
+            host=_parse_host_scope(host, spec, cls.EXPECTED) if hsep else None,
+        )
+
+    def scoped_here(self) -> bool:
+        """True when this process must ACT on the fault (unscoped, or the
+        scope names this process)."""
+        if self.host is None:
+            return True
+        try:
+            import jax
+
+            return int(jax.process_index()) == self.host
+        except Exception:
+            return self.host == 0
+
+
+_SHARD_CRASH_EXPECTED = "<after_shard|before_manifest>@<step>[:host<p>]"
+
+
+def parse_shard_crash_spec(spec: str | None) -> tuple[str, int, int | None] | None:
+    """Strict parse of ``RUSTPDE_SHARD_CRASH`` into ``(point, step, host)``.
+
+    ``point`` names a phase of the two-phase commit protocol (see
+    utils/checkpoint._shard_crash_hook); anything else — unknown point,
+    non-integer step, malformed host scope — raises
+    :class:`FaultSpecError` instead of silently never firing."""
+    if not spec:
+        return None
+    point, sep, rest = spec.partition("@")
+    if not sep or point not in SHARD_CRASH_POINTS:
+        raise FaultSpecError(
+            spec, _SHARD_CRASH_EXPECTED, f"unknown crash point {point!r}"
+        )
+    at, hsep, host = rest.partition(":")
+    try:
+        step = int(at)
+    except ValueError:
+        raise FaultSpecError(
+            spec, _SHARD_CRASH_EXPECTED, f"bad step {at!r}, expected an integer"
+        ) from None
+    return point, step, (
+        _parse_host_scope(host, spec, _SHARD_CRASH_EXPECTED) if hsep else None
+    )
+
+
+def validate_fault_env() -> None:
+    """Parse every fault-injection env var once, at startup: a chaos run
+    whose spec cannot fire must die HERE, not report green.  Called by the
+    harness constructors (ResilientRunner, SimServer)."""
+    FaultPlan.from_spec(os.environ.get("RUSTPDE_FAULT"))
+    parse_shard_crash_spec(os.environ.get("RUSTPDE_SHARD_CRASH"))

@@ -60,9 +60,9 @@ training-loop pattern (ROADMAP north star): swap "spectral coefficients" for
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import faulthandler
-import json
 import os
 import signal
 import sys
@@ -72,9 +72,11 @@ import time as _time
 import numpy as np
 
 from . import checkpoint
+from .faults import FaultPlan, FaultSpecError, validate_fault_env  # noqa: F401
 from .governor import StabilityGovernor
 from .integrate import integrate
 from .io_pipeline import IOPipeline
+from .journal import JournalWriter, read_journal
 
 
 class DispatchHang(RuntimeError):
@@ -147,79 +149,6 @@ def _single_process() -> bool:
         return jax.process_count() == 1
     except Exception:
         return True
-
-
-@dataclasses.dataclass
-class FaultPlan:
-    """Parsed ``RUSTPDE_FAULT`` spec ``<kind>@<step>[:host<p>]``: inject
-    ``kind`` once when the run's global step counter reaches ``step``,
-    optionally scoped to ONE process of a multihost job (``host`` = process
-    index; every host still *fires* the plan at the same step so collective
-    dispatch stays aligned — only the scoped host acts).
-
-    * ``nan``   — poison the state (every recovery path downstream of the
-      model's NaN break criterion); host-scoped, only the columns owned by
-      that host's devices are poisoned (a single-host fault that then
-      propagates through the collective step, the realistic multihost
-      divergence shape),
-    * ``spike`` — scale the velocity fields by ``spike_factor`` on-device:
-      the state stays *finite* but its CFL number blows past the sentinel
-      ceiling, so this exercises the stability governor's pre-divergence
-      catch + in-memory rollback + dt-ladder descent/regrowth — and, on an
-      ungoverned run, the incipient-blow-up-to-NaN path; host-scoped like
-      ``nan``,
-    * ``kill``  — SIGTERM this process (the preemption path).  HOST-SCOPED
-      kill is a hard ``SIGKILL`` instead: one host of a multihost job dying
-      without ceremony (the surviving hosts hit the next collective and
-      need ``RUSTPDE_SYNC_TIMEOUT_S`` to convert the wedge into a
-      structured :class:`DispatchHang`),
-    * ``slow``  — stall the next dispatch past the watchdog deadline (the
-      :class:`DispatchHang` path); host-scoped, only that host stalls.
-
-    The two-phase checkpoint WINDOW faults (kill between shard fsync and
-    manifest commit) are a separate hook — ``RUSTPDE_SHARD_CRASH``, see
-    utils/checkpoint._shard_crash_hook — because they key on a phase of the
-    commit protocol, not a step count."""
-
-    kind: str
-    step: int
-    host: int | None = None
-    fired: bool = False
-
-    KINDS = ("nan", "spike", "kill", "slow")
-
-    @classmethod
-    def from_spec(cls, spec: str | None) -> "FaultPlan | None":
-        if not spec:
-            return None
-        kind, sep, rest = spec.partition("@")
-        at, hsep, host = rest.partition(":")
-        if kind not in cls.KINDS or not sep:
-            raise ValueError(
-                f"bad fault spec {spec!r}: expected "
-                "<nan|spike|kill|slow>@<step>[:host<p>]"
-            )
-        if hsep and (not host.startswith("host") or not host[4:].isdigit()):
-            raise ValueError(
-                f"bad fault scope {host!r} in {spec!r}: expected host<p>"
-            )
-        return cls(
-            kind=kind,
-            step=int(at),
-            host=int(host[4:]) if hsep else None,
-        )
-
-    def scoped_here(self) -> bool:
-        """True when this process must ACT on the fault (unscoped, or the
-        scope names this process)."""
-        if self.host is None:
-            return True
-        try:
-            import jax
-
-            return int(jax.process_index()) == self.host
-        except Exception:
-            return self.host == 0
 
 
 def _host_column_mask(pde, host: int, leaf, hit, miss=1.0):
@@ -380,6 +309,11 @@ class ResilientRunner:
             env = os.environ.get("RUSTPDE_DISPATCH_TIMEOUT_S", "")
             dispatch_timeout_s = float(env) if env else None
         self.dispatch_timeout_s = dispatch_timeout_s
+        # STRICT env validation at construction (utils/faults): a malformed
+        # RUSTPDE_FAULT / RUSTPDE_SHARD_CRASH must kill the run before any
+        # stepping — a chaos spec that silently never fires reports green
+        # while testing nothing
+        validate_fault_env()
         self.fault = FaultPlan.from_spec(
             fault if fault is not None else os.environ.get("RUSTPDE_FAULT")
         )
@@ -412,11 +346,17 @@ class ResilientRunner:
         # journal event) — committed at the next chunk boundary
         self._pending_commit: tuple | None = None
         self._io_snapshot_s = 0.0  # main-thread seconds staging host snapshots
-        self._lock = threading.Lock()  # journal appends + ckpt-path updates
+        self._lock = threading.Lock()  # ckpt-path updates (journal has its own)
         self.journal_path = os.path.join(run_dir, "journal.jsonl")
+        # per-event-flushed shared writer (utils/journal): an embedding
+        # harness (serve.SimServer) may hand the runner ITS writer so
+        # request_* and checkpoint events ride one file — see set_journal
+        self._journal_writer: JournalWriter | None = None
+        self._journal_owned = True  # close on teardown unless set_journal'd
 
         self.step = 0  # global step counter (survives resume via ckpt attrs)
         self.attempt = 0  # divergence retries so far
+        self.resumed = False  # set by session(): a checkpoint was restored
         self._interrupt: int | None = None
         self._slow_pending = False
         self._t0 = _time.monotonic()
@@ -442,15 +382,28 @@ class ResilientRunner:
 
     # -- journal -------------------------------------------------------------
 
+    def set_journal(self, writer: JournalWriter) -> None:
+        """Adopt an externally-owned journal writer (the serve scheduler's:
+        one journal for request_* AND runner events).  The runner then never
+        closes it — the owner does."""
+        self._journal_writer = writer
+        self._journal_owned = False
+        self.journal_path = writer.path
+
     def _journal(self, event: dict) -> None:
         """Append one JSON line to ``<run_dir>/journal.jsonl`` (root only).
 
-        Thread-safe: async checkpoint completions journal from the pipeline
-        worker — the lock keeps lines whole, and events carrying their own
-        ``step``/``time`` (captured at submit) override the defaults, so a
-        write that lands mid-chunk is stamped with the step it snapshot."""
+        Thread-safe and flushed per event (utils/journal.JournalWriter):
+        async checkpoint completions journal from the pipeline worker, and
+        a SIGKILL can tear at most the line in flight.  Events carrying
+        their own ``step``/``time`` (captured at submit) override the
+        defaults, so a write that lands mid-chunk is stamped with the step
+        it snapshot."""
         if not _is_root():
             return
+        if self._journal_writer is None:
+            self._journal_writer = JournalWriter(self.journal_path)
+            self._journal_owned = True
         record = {
             "wall_s": round(_time.monotonic() - self._t0, 3),
             "step": self.step,
@@ -458,13 +411,7 @@ class ResilientRunner:
             "attempt": self.attempt,
             **event,
         }
-        try:
-            with self._lock:
-                os.makedirs(self.run_dir, exist_ok=True)
-                with open(self.journal_path, "a", encoding="utf-8") as fh:
-                    fh.write(json.dumps(record) + "\n")
-        except OSError as exc:  # journaling must never kill the run
-            print(f"unable to append journal {self.journal_path}: {exc}")
+        self._journal_writer.append(record)
 
     def _nu(self):
         """Scalar Nu for the journal: the value for a single run, the
@@ -1166,24 +1113,16 @@ class ResilientRunner:
         """Every journaled dt change as ``(event, step, dt)`` — the evidence
         trail :class:`DivergenceError` reports when retries are exhausted."""
         traj = []
-        try:
-            with open(self.journal_path, encoding="utf-8") as fh:
-                for line in fh:
-                    try:
-                        rec = json.loads(line)
-                    except ValueError:
-                        continue
-                    dt = rec.get("dt")
-                    if dt is not None and rec.get("event") in (
-                        "start",
-                        "dt_restored",
-                        "dt_adjust",
-                        "retry",
-                        "divergence",
-                    ):
-                        traj.append((rec["event"], rec.get("step"), dt))
-        except OSError:
-            pass
+        for rec in read_journal(self.journal_path, on_error="skip"):
+            dt = rec.get("dt")
+            if dt is not None and rec.get("event") in (
+                "start",
+                "dt_restored",
+                "dt_adjust",
+                "retry",
+                "divergence",
+            ):
+                traj.append((rec["event"], rec.get("step"), dt))
         return traj
 
     def _rollback(self) -> None:
@@ -1234,6 +1173,78 @@ class ResilientRunner:
 
     # -- the harness loop ----------------------------------------------------
 
+    @contextlib.contextmanager
+    def session(self, install_signals: bool = True, resume: bool | None = None):
+        """Arm the harness WITHOUT the driver loop — the embedding surface
+        for supervisors that own their own scheduling (serve.SimServer's
+        continuously-batched slot loop).  Inside the block the runner's
+        services are live exactly as under :meth:`run`: the IO pipeline and
+        checkpoint format are selected, the governor armed, signals
+        installed (``install_signals=False`` leaves them to the embedder),
+        and a resume restores the newest valid checkpoint (``resume``
+        overrides the constructor flag; the result is ``self.resumed``).
+        The embedder drives :meth:`advance` / :meth:`checkpoint_now` /
+        :meth:`drain_requested` and the context exit settles the pipeline
+        and restores signal handlers — including on the
+        :class:`DispatchHang` path, where lagged diagnostics are abandoned
+        rather than resolved against a wedged device."""
+        self.resumed = False
+        if install_signals:
+            self._install_signals()
+        self._setup_io()
+        try:
+            if self.resume if resume is None else resume:
+                self.resumed = self._maybe_resume()
+            self._setup_governor()
+            yield self
+        except DispatchHang:
+            # the runtime is wedged: teardown's diag flush would fetch from
+            # the dead dispatch and block forever (un-watchdogged), eating
+            # the structured raise — drop the lagged lines instead (the
+            # background writer holds host-side data only, so its drain in
+            # _teardown_io stays safe)
+            if self._io is not None:
+                self._io.abandon_diags()
+            raise
+        finally:
+            self._teardown_io()
+            if install_signals:
+                self._restore_signals()
+
+    # -- the embedding surface (serve.SimServer) ------------------------------
+
+    def advance(self, n: int) -> None:
+        """Advance up to ``n`` steps through the full dispatch stack —
+        fault injection, watchdog deadlines, sub-chunking, governor — the
+        supervisor-facing form of the private ``integrate`` hook.  May
+        commit fewer than ``n`` steps (pending signal, governor re-plan);
+        ``self.step`` counts what actually committed, so the caller loops
+        on its own accounting."""
+        self._dispatch(self.pde, n)
+
+    def checkpoint_now(self, reason: str = "manual") -> str | None:
+        """Write a checkpoint outside the cadence (drain, slot-table edge):
+        same collective/async semantics as the internal cadence writer."""
+        return self._checkpoint(reason)
+
+    def request_drain(self) -> None:
+        """Programmatic SIGTERM-equivalent: the next chunk boundary sees
+        :meth:`drain_requested` true — the serve drain path rides the same
+        deferred-interrupt machinery as real preemption."""
+        self._interrupt = signal.SIGTERM
+
+    def drain_requested(self) -> bool:
+        """True when a signal (or :meth:`request_drain`) asked for a stop —
+        root-decided on multihost, like every collective-adjacent flag."""
+        return self._preempt_agreed()
+
+    def on_boundary(self) -> bool:
+        """Chunk-boundary housekeeping for embedding supervisors — exactly
+        the hook ``integrate()`` drives: settle any deferred sharded
+        commit, write a cadence checkpoint when due, and return True when
+        a drain/preemption was requested."""
+        return bool(self._on_chunk(self.pde))
+
     def run(self) -> dict:
         """Drive the model to ``max_time``, surviving what can be survived.
 
@@ -1250,15 +1261,11 @@ class ResilientRunner:
                 "checkpoints from a previous run; clear the directory or "
                 "drop resume=False"
             )
-        self._install_signals()
-        self._setup_io()
-        try:
-            resumed = self._maybe_resume()
-            self._setup_governor()
+        with self.session():
             self._journal(
                 {
                     "event": "start",
-                    "resumed": resumed,
+                    "resumed": self.resumed,
                     "dt": float(pde.get_dt()),
                     "max_time": self.max_time,
                     "governed": self.governor is not None,
@@ -1319,18 +1326,6 @@ class ResilientRunner:
                     )
                 self.attempt += 1
                 self._rollback()
-        except DispatchHang:
-            # the runtime is wedged: teardown's diag flush would fetch from
-            # the dead dispatch and block forever (un-watchdogged), eating
-            # the structured raise — drop the lagged lines instead (the
-            # background writer holds host-side data only, so its drain in
-            # _teardown_io stays safe)
-            if self._io is not None:
-                self._io.abandon_diags()
-            raise
-        finally:
-            self._teardown_io()
-            self._restore_signals()
 
     def _setup_io(self) -> None:
         """Build the overlapped-IO pipeline for this run (run() entry).
@@ -1419,6 +1414,10 @@ class ResilientRunner:
         saved = getattr(self, "_saved_pde_io", None)
         if getattr(self.pde, "io_pipeline", None) is not saved:
             self.pde.io_pipeline = saved
+        # release the journal handle (reopens lazily if journaled again);
+        # an adopted writer belongs to the embedding supervisor — not ours
+        if self._journal_writer is not None and self._journal_owned:
+            self._journal_writer.close()
 
     def _setup_governor(self) -> None:
         """Arm the sentinels + build the dt governor (run() start, after a

@@ -5,6 +5,15 @@ cadence, not just an on-demand env knob).
 
 Usage:  python scripts/record_tests.py            # full suite (RUSTPDE_SLOW=1)
         python scripts/record_tests.py --fast     # fast tier only
+
+Per-test durations (``--durations``-style) are parsed from every run and
+recorded in TESTS.json, and the FAST tier enforces a per-test wall budget
+(``RUSTPDE_TEST_BUDGET_S``, default 45 s per test call — the slowest
+tier-1 test sits at ~20 s, so the gate only trips on a genuine 2x+
+regression, not scheduler noise on a contended box): a tier-1 test
+that outgrows its budget fails the run (rc=3) the PR it regresses, instead
+of silently eating the suite's 870 s clock until the whole tier times out
+(the rc=124-at-HEAD failure mode this repo has already hit once).
 """
 
 import argparse
@@ -28,11 +37,12 @@ def main() -> int:
     if not args.fast:
         env["RUSTPDE_SLOW"] = "1"
     tier = "fast" if args.fast else "full (RUSTPDE_SLOW=1)"
+    budget_s = float(os.environ.get("RUSTPDE_TEST_BUDGET_S", "45"))
     timeout_s = 7200
     t0 = time.time()
     try:
         proc = subprocess.run(
-            [sys.executable, "-m", "pytest", "tests/", "-q"],
+            [sys.executable, "-m", "pytest", "tests/", "-q", "--durations=0"],
             cwd=_REPO,
             env=env,
             capture_output=True,
@@ -79,6 +89,12 @@ def main() -> int:
         # the suite gets is visible across PRs even when the summary line
         # is missing (hang/kill)
         "dots_passed": _dots_passed(proc.stdout or ""),
+        # per-test duration profile (the 15 slowest call phases) + budget
+        # verdict: tier-1 regressions are caught per-PR, not when the whole
+        # suite first blows its 870 s clock
+        "durations": dict(_durations(proc.stdout or "")[:15]),
+        "budget_s": budget_s,
+        "over_budget": _over_budget(proc.stdout or "", budget_s),
         "wall_s": round(wall, 1),
         "returncode": proc.returncode,
         # sharded-checkpoint IO counters from the last recorded
@@ -92,7 +108,39 @@ def main() -> int:
     print(json.dumps(record))
     if proc.returncode != 0:
         sys.stderr.write(proc.stdout[-4000:])
-    return proc.returncode
+        return proc.returncode
+    # the budget gate applies to the FAST (= tier-1) selection only: slow-
+    # tier tests (multiprocess spawns, soaks) legitimately run for minutes
+    if args.fast and record["over_budget"]:
+        sys.stderr.write(
+            f"tier-1 per-test budget ({budget_s:.0f}s) exceeded by: "
+            f"{record['over_budget']}\n"
+        )
+        return 3
+    return 0
+
+
+_DURATION_LINE = re.compile(
+    r"^\s*([0-9]+\.[0-9]+)s\s+(call|setup|teardown)\s+(\S+)\s*$"
+)
+
+
+def _durations(out: str) -> list:
+    """``[(testid, seconds), ...]`` slowest-first from pytest's
+    ``--durations=0`` report (call phases only: setup/teardown time is
+    fixture-shared and double-counts across tests)."""
+    found = []
+    for line in out.splitlines():
+        m = _DURATION_LINE.match(line)
+        if m and m.group(2) == "call":
+            found.append((m.group(3), float(m.group(1))))
+    found.sort(key=lambda kv: -kv[1])
+    return found
+
+
+def _over_budget(out: str, budget_s: float) -> list:
+    """Test ids whose call phase exceeded the per-test budget."""
+    return [tid for tid, s in _durations(out) if s > budget_s]
 
 
 def _dots_passed(out: str) -> int:

@@ -49,6 +49,21 @@ if _DUMP_AFTER_S > 0:
     faulthandler.dump_traceback_later(_DUMP_AFTER_S, exit=False)
 
 
+@pytest.fixture(scope="session")
+def stepped_rbc17():
+    """ONE stepped 17^2 model shared by the checkpoint/IO-layer tests
+    across test_resilience / test_io_pipeline / test_serve: they only need
+    *a* valid state to write/verify/restore, and every per-module build
+    was ~1-2 s of duplicated tier-1 wall (plus duplicated trace time).
+    The state is SCRATCH — tests may read snapshots into it or step it;
+    nothing may assume a particular state on entry."""
+    from model_builders import build_rbc17
+
+    model = build_rbc17()
+    model.update_n(4)
+    return model
+
+
 def pytest_configure(config):
     config.addinivalue_line(
         "markers", "slow: heavyweight end-to-end test (skipped unless RUSTPDE_SLOW=1 or -m slow)"

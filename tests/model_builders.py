@@ -1,0 +1,35 @@
+"""Shared tiny-model builders for the robustness-layer test files.
+
+test_resilience, test_io_pipeline, test_sharded_ckpt and test_serve all
+exercise harness machinery (checkpoints, journals, pipelines, scheduling)
+on top of the SAME small confined RBC configuration — the physics is
+incidental, the jit shapes are not: one set of builders keeps every file
+on identical shapes, so the whole tier compiles each entry point once per
+pytest process (and hits the persistent XLA cache across runs), instead of
+each module paying its own trace+compile for a cosmetically different
+model.  The matching session-scoped stepped fixture lives in conftest.py
+(``stepped_rbc17``).
+"""
+
+from rustpde_mpi_tpu import Navier2D
+
+
+def build_rbc17(dt=0.01):
+    """17^2 confined RBC at Ra=1e4 — the tier's canonical tiny model."""
+    model = Navier2D(17, 17, 1e4, 1.0, dt, 1.0, "rbc", periodic=False)
+    model.set_velocity(0.1, 1.0, 1.0)
+    model.set_temperature(0.1, 1.0, 1.0)
+    # keep the save-window callback from littering data/ with flow files;
+    # harness checkpoints/journals are what these tests assert on
+    model.write_intervall = 1e9
+    return model
+
+
+def build_rbc33(mesh=None, dt=0.01, nx=33, ny=32):
+    """33x32 build (optionally mesh-sharded) — the sharded-checkpoint
+    shape; nx/ny overridable for the odd-size edge cases."""
+    model = Navier2D(nx, ny, 1e4, 1.0, dt, 1.0, "rbc", periodic=False, mesh=mesh)
+    model.set_velocity(0.1, 1.0, 1.0)
+    model.set_temperature(0.1, 1.0, 1.0)
+    model.write_intervall = 1e9
+    return model

@@ -209,6 +209,7 @@ def test_dirichlet_neumann_operator_is_seven_banded():
                 assert abs(A[i, j]) < 1e-12, (i, j, A[i, j])
 
 
+@pytest.mark.slow
 def test_space2_leading_batch_dims():
     """Space transforms/gradients/solvers are polymorphic over extra leading
     batch dims (stacked same-space fields) and match per-field application."""

@@ -27,6 +27,7 @@ def _solo(seed, steps, **kw):
     return m
 
 
+@pytest.mark.slow
 def test_ensemble_matches_sequential_solo_runs():
     K, steps = 3, 7
     ens = NavierEnsemble.from_seeds(_model(), seeds=range(K))
@@ -135,6 +136,7 @@ def test_ensemble_snapshot_roundtrip(tmp_path):
     assert (np.asarray(ens2.steps_done) == 5).all()
 
 
+@pytest.mark.slow
 def test_profiling_reports_member_rate_and_mfu():
     from rustpde_mpi_tpu.utils.profiling import mfu_estimate
 

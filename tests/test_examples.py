@@ -32,6 +32,10 @@ _CASES = {
         "--spike-factor", "100", "--grow-after", "2",
     ],
     "navier_rbc_pipelined.py": ["--quick", "--max-time", "0.2"],
+    "navier_rbc_serve.py": [
+        "--quick", "--requests", "3", "--slots", "2", "--horizon", "0.05",
+        "--run-dir", "data/serve_smoke", "--fault", "nan@3",
+    ],
     "navier_rbc_roughness.py": ["--quick"],
     "navier_mpi.py": ["--quick"],
     "navier_rbc_steady.py": ["--quick"],

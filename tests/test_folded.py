@@ -96,6 +96,7 @@ def test_disable_env(monkeypatch):
     assert fm.kind == "plain"
 
 
+@pytest.mark.slow
 def test_space_transform_equivalence_folded_vs_plain(monkeypatch):
     """End-to-end: Space2 matmul transforms with folding on vs off."""
     import subprocess

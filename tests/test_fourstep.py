@@ -152,6 +152,7 @@ def test_biperiodic_fast_matches_fft(force_fourstep):
     np.testing.assert_allclose(np.asarray(sp.backward(jnp.asarray(a))), v, atol=1e-12)
 
 
+@pytest.mark.slow
 def test_navier_step_fast_vs_dense_transforms():
     """One full confined Navier2D step with the four-step transforms forced on
     matches the dense-transform step to near machine epsilon (the grid is

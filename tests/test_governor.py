@@ -200,6 +200,7 @@ def test_governed_stable_run_bit_identical(tmp_path):
     assert s1["health"] is None  # ungoverned runs carry no telemetry
 
 
+@pytest.mark.slow
 def test_spike_caught_pre_divergence_in_memory(tmp_path):
     """The acceptance demo: a deterministic velocity spike.  Governed, the
     CFL sentinel early-exits the chunk BEFORE NaNs, the rollback happens in
@@ -273,6 +274,7 @@ def test_ungoverned_sentinels_break_cleanly():
     assert not model.exit()
 
 
+@pytest.mark.slow
 def test_dt_ladder_cache_bounds_rejits():
     """Cycling the governor's dt ladder re-traces/refactorizes each rung at
     most once: revisits swap the cached artifacts back in (and the restored
@@ -298,6 +300,7 @@ def test_dt_ladder_cache_bounds_rejits():
     )
 
 
+@pytest.mark.slow
 def test_ensemble_batch_max_cfl_matches_serial():
     """The ensemble's per-member CFL sentinel must equal stepping each
     member through the single-run sentinel path, and the batch reduction is

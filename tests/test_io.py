@@ -70,6 +70,7 @@ def test_restart_with_resolution_change(tmp_path):
     assert np.all(np.isfinite(np.asarray(finer.state.temp)))
 
 
+@pytest.mark.slow
 def test_periodic_restart_with_resolution_change(tmp_path):
     """Periodic x-axis resolution change: the physical field must be
     preserved, not just coefficient prefixes.  This repo's r2c forward is

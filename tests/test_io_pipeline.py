@@ -12,32 +12,21 @@ import pytest
 from rustpde_mpi_tpu import (
     AsyncWriteError,
     IOPipeline,
-    Navier2D,
     NavierEnsemble,
     ResilientRunner,
     integrate,
 )
 from rustpde_mpi_tpu.config import IOConfig
 from rustpde_mpi_tpu.utils import checkpoint as cp
-from rustpde_mpi_tpu.utils.io_pipeline import AsyncCheckpointWriter, ObservableFuture
+from rustpde_mpi_tpu.utils.io_pipeline import AsyncCheckpointWriter
 from rustpde_mpi_tpu.utils.resilience import poison_state
 
 h5py = pytest.importorskip("h5py")
 
 
-def _build(dt=0.01):
-    model = Navier2D(17, 17, 1e4, 1.0, dt, 1.0, "rbc", periodic=False)
-    model.set_velocity(0.1, 1.0, 1.0)
-    model.set_temperature(0.1, 1.0, 1.0)
-    model.write_intervall = 1e9  # journal/ckpt IO is what these tests assert on
-    return model
-
-
-@pytest.fixture(scope="module")
-def stepped_model():
-    model = _build()
-    model.update_n(4)
-    return model
+# shared tier-wide builder (model_builders.py) + session-scoped stepped
+# model (conftest.stepped_rbc17): same jit shapes as test_resilience etc.
+from model_builders import build_rbc17 as _build
 
 
 def _events(run_dir):
@@ -48,12 +37,12 @@ def _events(run_dir):
 # -- write-side digest + host-snapshot split ---------------------------------
 
 
-def test_write_side_digest_matches_readback(tmp_path, stepped_model):
+def test_write_side_digest_matches_readback(tmp_path, stepped_rbc17):
     """The digest stamped from the in-memory arrays (no file read-back) must
     equal the digest a reader computes from the file — the contract the
     whole verify/corrupt-skip machinery rides on."""
     path = str(tmp_path / "snap.h5")
-    cp.write_snapshot(stepped_model, path, step=4)
+    cp.write_snapshot(stepped_rbc17, path, step=4)
     attrs = cp.verify_snapshot(path)  # raises on any digest mismatch
     with h5py.File(path, "r") as h5:
         assert attrs["digest"] == cp.content_digest(h5)
@@ -81,13 +70,13 @@ def test_ensemble_write_side_digest_and_dtypes(tmp_path):
         )
 
 
-def test_async_write_bit_identical_to_sync(tmp_path, stepped_model):
+def test_async_write_bit_identical_to_sync(tmp_path, stepped_rbc17):
     """A host snapshot serialized on the background worker must be byte-level
     the file the synchronous writer produces (same content digest)."""
     sync_path = str(tmp_path / "sync.h5")
     async_path = str(tmp_path / "async.h5")
-    cp.write_snapshot(stepped_model, sync_path, step=4)
-    snap = cp.snapshot_to_host(stepped_model, step=4)
+    cp.write_snapshot(stepped_rbc17, sync_path, step=4)
+    snap = cp.snapshot_to_host(stepped_rbc17, step=4)
     pipe = IOPipeline()
     pipe.submit_write(lambda: cp.write_host_snapshot(snap, async_path), async_path)
     pipe.drain()
@@ -101,13 +90,13 @@ def test_async_write_bit_identical_to_sync(tmp_path, stepped_model):
 # -- futures ------------------------------------------------------------------
 
 
-def test_observable_future_matches_sync(stepped_model):
-    fut = stepped_model.get_observables_async()
-    vals = stepped_model.get_observables()  # resolves through the same future
+def test_observable_future_matches_sync(stepped_rbc17):
+    fut = stepped_rbc17.get_observables_async()
+    vals = stepped_rbc17.get_observables()  # resolves through the same future
     assert fut.ready()
     assert fut.result() == vals
     assert len(vals) == 4 and all(isinstance(v, float) for v in vals)
-    assert not stepped_model.exit_future().result()
+    assert not stepped_rbc17.exit_future().result()
 
 
 def test_exit_future_detects_nan():
@@ -427,6 +416,7 @@ def test_rollback_read_never_races_pending_write(tmp_path, monkeypatch):
         runner._teardown_io()
 
 
+@pytest.mark.slow
 def test_governed_overlap_matches_blocking_and_catches_spike(tmp_path):
     """The lag=1 sentinel contract: a GOVERNED overlapped run at a stable
     dt is bit-identical to the blocking governed run, and a governed

@@ -28,6 +28,7 @@ def _lnse(nx=14, ny=11, ra=3e3, pr=0.1, dt=0.01, cls=Navier2DLnse, seed=1):
 # -- linear stability physics -------------------------------------------------
 
 
+@pytest.mark.slow
 def test_lnse_subcritical_perturbations_decay():
     """About the conduction state below Ra_c ~ 1708 every perturbation decays."""
     model = _lnse(ra=1000.0)
@@ -75,6 +76,7 @@ def test_nonlin_with_conduction_mean_equals_navier2d():
 # -- gradients ---------------------------------------------------------------
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("cls", [Navier2DLnse, Navier2DNonLin])
 def test_autodiff_gradient_matches_directional_fd(cls):
     """jax.grad through the scanned forward loop is the exact gradient of the
@@ -96,6 +98,7 @@ def test_autodiff_gradient_matches_directional_fd(cls):
     assert ad == pytest.approx(fd, rel=1e-5)
 
 
+@pytest.mark.slow
 def test_fd_gradient_matches_autodiff_pointwise():
     """The ported brute-force FD gradient (vmapped) agrees with autodiff."""
     model = _lnse(nx=10, ny=9)
@@ -111,6 +114,7 @@ def test_fd_gradient_matches_autodiff_pointwise():
         assert num / den < 1e-2
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("cls", [Navier2DLnse, Navier2DNonLin])
 def test_hand_adjoint_gradient_agreement(cls):
     """Port of the reference's adjoint-vs-FD validation

@@ -106,6 +106,7 @@ def test_sharded_state_placement():
         assert i1 != slice(None)  # axis 1 actually split
 
 
+@pytest.mark.slow
 def test_sharded_adjoint_matches_serial():
     """Steady-state adjoint descent under the pencil mesh == serial."""
     import jax
@@ -131,6 +132,7 @@ def test_sharded_adjoint_matches_serial():
     assert sharded.residual() == pytest.approx(serial.residual(), rel=1e-9)
 
 
+@pytest.mark.slow
 def test_sharded_lnse_matches_serial():
     """Linearized NSE forward + adjoint steps under the mesh == serial."""
     import jax
@@ -157,6 +159,7 @@ def test_sharded_lnse_matches_serial():
     )
 
 
+@pytest.mark.slow
 def test_sharded_navier_with_fast_transforms():
     """The four-step transform + cumsum-derivative paths must shard cleanly
     under the pencil mesh (the flagship grids sit above the auto gates, so
@@ -310,6 +313,7 @@ def test_sharded_sep_layout_matches_serial(monkeypatch):
     "test_sharded_split_periodic_fallback_guard below).",
     strict=False,
 )
+@pytest.mark.slow
 def test_sharded_split_periodic_mixed_sep_matches_serial(monkeypatch):
     """The REAL multi-chip periodic path: split Re/Im Fourier x Chebyshev
     with the Chebyshev axis in the sep layout (the at-scale periodic1024

@@ -26,24 +26,9 @@ h5py = pytest.importorskip("h5py")
 
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
-
-def _build(dt=0.01):
-    model = Navier2D(17, 17, 1e4, 1.0, dt, 1.0, "rbc", periodic=False)
-    model.set_velocity(0.1, 1.0, 1.0)
-    model.set_temperature(0.1, 1.0, 1.0)
-    # keep the save-window callback from littering data/ with flow files;
-    # runner checkpoints are what these tests assert on
-    model.write_intervall = 1e9
-    return model
-
-
-@pytest.fixture(scope="module")
-def shared_model():
-    """One model for the checkpoint-layer tests (they only need *a* state to
-    write/read — sharing the build keeps the tier-1 wall time down)."""
-    model = _build()
-    model.update_n(2)
-    return model
+# shared tier-wide builder + session-scoped stepped model (conftest.py):
+# test_io_pipeline/test_sharded_ckpt/test_serve reuse the same jit shapes
+from model_builders import build_rbc17 as _build
 
 
 def _events(run_dir):
@@ -54,7 +39,7 @@ def _events(run_dir):
 # -- durable checkpoints ------------------------------------------------------
 
 
-def test_atomic_write_crash_safety(tmp_path, shared_model):
+def test_atomic_write_crash_safety(tmp_path, stepped_rbc17):
     """Kill the writer mid-``write_snapshot``: the previous checkpoint must
     still read back digest-clean (atomicity), with at worst a ``.tmp``
     leftover that the checkpoint listing ignores."""
@@ -99,14 +84,14 @@ os._exit(1)                                  # unreachable if the kill fired
     # the step-2 checkpoint is intact and digest-clean
     attrs = cp.verify_snapshot(path)
     assert int(attrs["step"]) == 2
-    shared_model.read(path)
-    assert shared_model.time == pytest.approx(0.02)
+    stepped_rbc17.read(path)
+    assert stepped_rbc17.time == pytest.approx(0.02)
     # listing skips any .tmp corpse the kill left behind
     assert cp.checkpoint_files(str(tmp_path)) == [path]
 
 
-def test_truncated_file_rejected_and_latest_skips(tmp_path, shared_model):
-    model = shared_model
+def test_truncated_file_rejected_and_latest_skips(tmp_path, stepped_rbc17):
+    model = stepped_rbc17
     good = cp.checkpoint_path(str(tmp_path), 2)
     cp.write_snapshot(model, good, step=2)
     model.update_n(2)
@@ -122,8 +107,8 @@ def test_truncated_file_rejected_and_latest_skips(tmp_path, shared_model):
     assert cp.latest_checkpoint(str(tmp_path)) == good
 
 
-def test_digest_mismatch_rejected(tmp_path, shared_model):
-    model = shared_model
+def test_digest_mismatch_rejected(tmp_path, stepped_rbc17):
+    model = stepped_rbc17
     path = cp.checkpoint_path(str(tmp_path), 0)
     cp.write_snapshot(model, path, step=0)
     with h5py.File(path, "r+") as h5:
@@ -135,10 +120,10 @@ def test_digest_mismatch_rejected(tmp_path, shared_model):
     assert cp.latest_checkpoint(str(tmp_path)) is None
 
 
-def test_checkpoint_errors_are_typed(tmp_path, shared_model):
+def test_checkpoint_errors_are_typed(tmp_path, stepped_rbc17):
     """Malformed files raise CheckpointError naming the file and the missing
     group/dataset — not bare KeyError / h5py OSError."""
-    model = shared_model
+    model = stepped_rbc17
     empty = str(tmp_path / "empty.h5")
     with h5py.File(empty, "w"):
         pass
@@ -164,8 +149,8 @@ def test_checkpoint_errors_are_typed(tmp_path, shared_model):
     model.read_unwrap(empty)
 
 
-def test_rotation_keeps_window(tmp_path, shared_model):
-    model = shared_model
+def test_rotation_keeps_window(tmp_path, stepped_rbc17):
+    model = stepped_rbc17
     for step in range(5):
         cp.write_snapshot(model, cp.checkpoint_path(str(tmp_path), step), step=step)
         cp.rotate_checkpoints(str(tmp_path), keep=3)
@@ -205,6 +190,7 @@ def test_fault_spec_parsing():
 # -- the runner ---------------------------------------------------------------
 
 
+@pytest.mark.slow
 def test_nan_rollback_dt_backoff_matches_clean_run(tmp_path):
     """The end-to-end recovery demo: a NaN injected mid-run rolls back to
     the anchor checkpoint, halves dt, and completes; the journal records the
@@ -340,14 +326,14 @@ def test_preempt_without_save_intervall(tmp_path):
     assert int(cp.verify_snapshot(summary["checkpoint"])["step"]) == summary["step"]
 
 
-def test_fresh_run_refuses_stale_run_dir(tmp_path, shared_model):
+def test_fresh_run_refuses_stale_run_dir(tmp_path, stepped_rbc17):
     """resume=False on a run_dir holding a previous campaign's checkpoints
     must refuse: a later rollback would silently splice the old campaign's
     trajectory into the new run."""
     run_dir = str(tmp_path / "run")
-    cp.write_snapshot(shared_model, cp.checkpoint_path(run_dir, 7), step=7)
+    cp.write_snapshot(stepped_rbc17, cp.checkpoint_path(run_dir, 7), step=7)
     runner = ResilientRunner(
-        shared_model, max_time=0.1, run_dir=run_dir, resume=False
+        stepped_rbc17, max_time=0.1, run_dir=run_dir, resume=False
     )  # raises before touching the model
     with pytest.raises(ValueError, match="previous run"):
         runner.run()

@@ -187,6 +187,7 @@ def test_mixed_sep_periodic_space(monkeypatch):
         )
 
 
+@pytest.mark.slow
 def test_periodic_model_forced_sep_matches_default():
     """A periodic Navier model with the Chebyshev axis forced sep
     (RUSTPDE_SEP=1) reproduces the default-layout trajectory to roundoff —

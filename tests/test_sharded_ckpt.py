@@ -13,7 +13,7 @@ import jax
 import numpy as np
 import pytest
 
-from rustpde_mpi_tpu import Navier2D, NavierEnsemble, ResilientRunner
+from rustpde_mpi_tpu import NavierEnsemble, ResilientRunner
 from rustpde_mpi_tpu.config import IOConfig
 from rustpde_mpi_tpu.parallel.mesh import make_mesh
 from rustpde_mpi_tpu.utils import checkpoint as cp
@@ -24,19 +24,12 @@ h5py = pytest.importorskip("h5py")
 _FIELDS = ("temp", "velx", "vely", "pres", "pseu")
 
 
-def _build(mesh=None, dt=0.01, nx=33, ny=32):
-    model = Navier2D(nx, ny, 1e4, 1.0, dt, 1.0, "rbc", periodic=False, mesh=mesh)
-    model.set_velocity(0.1, 1.0, 1.0)
-    model.set_temperature(0.1, 1.0, 1.0)
-    model.write_intervall = 1e9
-    return model
-
-
-def _build17(dt=0.01):
-    """17^2 serial build — every jit shape here is already compiled by
-    test_resilience.py earlier in the same pytest process, so the runner
-    tests below add no fresh compile time to the tier-1 budget."""
-    return _build(nx=17, ny=17, dt=dt)
+# shared tier-wide builders (model_builders.py): every jit shape here is
+# already compiled by test_io_pipeline/test_resilience earlier in the same
+# pytest process, so these tests add no fresh compile time to the tier-1
+# budget
+from model_builders import build_rbc17 as _build17
+from model_builders import build_rbc33 as _build
 
 
 def _assert_state_equal(a, b, exact=True, atol=1e-12):

@@ -122,6 +122,7 @@ def test_set_solid_none_restores_plain_step():
     )
 
 
+@pytest.mark.slow
 def test_penalized_sharded_matches_serial():
     """The penalization is elementwise in physical space — it must shard
     transparently under the pencil mesh."""

@@ -87,6 +87,7 @@ def test_nusselt_field_volume_average_matches_nuvol():
     assert vol_avg == pytest.approx(model.eval_nuvol(), rel=2e-2, abs=1e-3)
 
 
+@pytest.mark.slow
 def test_callback_integration_writes_statistics(tmp_path, monkeypatch):
     monkeypatch.chdir(tmp_path)
     model = _model()

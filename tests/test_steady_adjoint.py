@@ -25,6 +25,7 @@ def test_residual_decreases():
     assert np.isfinite(model.div_norm())
 
 
+@pytest.mark.slow
 def test_subcritical_converges_to_conduction():
     """Ra=100 << Ra_c from zero fields: the descent settles into the
     conduction state (hydrostatic pressure builds over the first iterations),

@@ -151,6 +151,7 @@ def test_penalization_tpu_path_matches_default(tpu_path, monkeypatch):
     )
 
 
+@pytest.mark.slow
 def test_f64_hybrid_tracks_full_f64():
     """RUSTPDE_F64_HYBRID=1 (f32 convection transforms feeding f64 solves,
     SURVEY S7 hybrid): state stays f64 and a 50-step trajectory tracks the
@@ -196,6 +197,7 @@ def test_f64_hybrid_tracks_full_f64():
     assert obs["1"][3] < 2 * max(obs["0"][3], 1e-12)
 
 
+@pytest.mark.slow
 def test_f64_hybrid_sharded_matches_serial():
     """The f64 hybrid under the 8-device pencil mesh == serial hybrid: the
     f32-cast convection operators must partition cleanly under GSPMD (real
