@@ -13,7 +13,8 @@ Environment knobs:
                                   poisson1025_f64, rbc1025, rbc1025_f64,
                                   sh2048, rbc2049, rbc2049_f64, rbc129_f64,
                                   ensemble129, resilience129, governor129,
-                                  pipeline129, shardedio129, serve129
+                                  pipeline129, shardedio129, serve129,
+                                  workloads129
     RUSTPDE_BENCH_STEPS    timed window for the primary config (default 64;
                            rates are slope-timed over windows L and 4L, see
                            utils/profiling.benchmark_steps)
@@ -72,6 +73,7 @@ DEFAULT_CONFIGS = [
     "pipeline129",
     "shardedio129",
     "serve129",
+    "workloads129",
     "periodic",
     "poisson1025",
     "poisson1025_f64",
@@ -98,6 +100,7 @@ METRIC_NAMES = {
     "pipeline129": "2D RBC confined 129x129 Ra=1e7 overlapped I/O pipeline (async checkpoints + dispatch double-buffering)",
     "shardedio129": "2D RBC sharded two-phase checkpoints, 2-proc CPU harness (sharded vs gathered write + elastic-restore gate)",
     "serve129": "2D RBC simulation service 129x129 Ra=1e7, 200 requests / 8 slots soak (drain+NaN chaos; member-steps/s + latency percentiles)",
+    "workloads129": "multi-model workloads 129x129 (dns/lnse/adjoint member-steps/s per kind + solo-vs-ensemble parity + lnse onset-sign gate)",
     "periodic": "2D RBC periodic 128x65 Ra=1e6",
     "periodic1024": "2D RBC periodic 1024x1025 Ra=1e9",
     "poisson1025": "Poisson standalone 1025x1025",
@@ -860,6 +863,77 @@ def bench_resilience(nx, ny, ra, dt, steps):
     }
 
 
+def bench_workloads(nx=129, ny=129, ra=1e7, dt=2e-3, steps=16, k=4):
+    """workloads129: the multi-model campaign subsystem
+    (rustpde_mpi_tpu/workloads/ + models/campaign.py).
+
+    Per registered model kind (dns / lnse / adjoint) a K-member vmapped
+    ensemble is slope-timed at 129^2 — ``member_steps_per_sec`` per kind is
+    the serving-capacity number for mixed-model campaigns.  Red/green
+    gates: (1) per-kind solo-vs-ensemble parity at the 17^2 probe shape
+    below 1e-9 relative (the PARITY.json drift probe), (2) the lnse
+    eigenmode machinery puts the growth-rate SIGN on the right side of
+    onset (decay at Ra=800, growth at Ra=4000 — the full Ra_c=1707.76 gate
+    lives in the slow test tier)."""
+    import numpy as np
+
+    from rustpde_mpi_tpu import config
+    from rustpde_mpi_tpu.models.ensemble import NavierEnsemble
+    from rustpde_mpi_tpu.utils.profiling import benchmark_steps
+    from rustpde_mpi_tpu.workloads import (
+        build_model,
+        eigenmode_sweep,
+        model_kinds,
+        solo_ensemble_parity,
+    )
+
+    config.enable_compilation_cache()
+    rates = {}
+    for kind in model_kinds():
+        kdt = 5e-3 if kind == "adjoint" else dt
+        model = build_model(kind, nx, ny, ra, 1.0, kdt, 1.0, "rbc", False)
+        members = []
+        for seed in range(k):
+            if kind == "adjoint":
+                model.set_temperature(0.3 + 0.05 * seed, 1.0, 1.0)
+                model.set_velocity(0.3 + 0.05 * seed, 1.0, 1.0)
+            else:
+                model.init_random(1e-2 if kind == "dns" else 1e-4, seed=seed)
+            members.append(model.state)
+        ens = NavierEnsemble(model, members)
+        res = benchmark_steps(ens, steps=steps, warmup=4)
+        rates[kind] = {
+            "member_steps_per_sec": res["member_steps_per_sec"],
+            "ms_per_member_step": res["ms_per_member_step"],
+            "k": k,
+        }
+
+    parity = solo_ensemble_parity(steps=6)
+    parity_ok = all(row["max_rel_diff"] < 1e-9 for row in parity.values())
+
+    sweep = eigenmode_sweep(
+        [800.0, 4000.0], nx=8, ny=17, dt=0.05, horizon=12.0, samples=6,
+        run_dir=None, checkpoint_every_s=None,
+    )
+    sigma_lo, sigma_hi = sweep[0]["sigma_max"], sweep[1]["sigma_max"]
+    onset_ok = bool(
+        np.isfinite([sigma_lo, sigma_hi]).all() and sigma_lo < 0.0 < sigma_hi
+    )
+
+    return {
+        # headline rate: the DNS kind (comparable to ensemble129)
+        "steps_per_sec": rates["dns"]["member_steps_per_sec"] / k,
+        "member_steps_per_sec": rates["dns"]["member_steps_per_sec"],
+        "kinds": rates,
+        "parity": parity,
+        "parity_ok": parity_ok,
+        "onset_sigma": {"ra800": sigma_lo, "ra4000": sigma_hi},
+        "onset_sign_ok": onset_ok,
+        "steps": steps,
+        "finite": bool(parity_ok and onset_ok),
+    }
+
+
 def _read_prev():
     """(platform, results) from BENCH_FULL.json, (None, {}) if absent/corrupt
     — the single reader shared by the degraded emitter, the cpu-fallback
@@ -1167,6 +1241,10 @@ def main() -> int:
                 # simulation-service soak: 200 requests through 8 slots in
                 # subprocess incarnations (drain + NaN chaos cycle)
                 r = bench_serve()
+            elif name == "workloads129":
+                # multi-model campaign rates (dns/lnse/adjoint) + the
+                # parity and onset-sign gates
+                r = bench_workloads(steps=max(8, min(steps, 32)))
             elif name == "governor129":
                 # overhead leg slope-times two chains; the spike legs rerun
                 # a capped horizon (governed: at the descended-ladder dt)

@@ -1,0 +1,65 @@
+"""Linear-stability workload: lnse eigenmode sweep for the critical
+Rayleigh number.
+
+Runs the workloads/eigenmodes.py campaign — per Rayleigh number, a vmapped
+ensemble of linearized perturbations seeded on different horizontal modes,
+governed and checkpointed under ResilientRunner — fits the leading growth
+rates from the streamed energy trajectory, and interpolates the growth-rate
+sign change.  For the rigid-rigid layer (periodic-x at the critical
+wavelength) the analytic answer is Ra_c = 1707.76 (Chandrasekhar).
+
+Usage:  python examples/navier_lnse_eigenmodes.py [--quick] [--run-dir DIR]
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from rustpde_mpi_tpu.workloads import (  # noqa: E402
+    RAC_RIGID,
+    critical_rayleigh,
+    eigenmode_sweep,
+)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true", help="tiny smoke sweep")
+    ap.add_argument("--run-dir", default="data/eigenmodes")
+    ap.add_argument("--ny", type=int, default=None)
+    ap.add_argument("--horizon", type=float, default=None)
+    args = ap.parse_args()
+
+    if args.quick:
+        ras = [1500.0, 1950.0]
+        ny = args.ny or 17
+        horizon = args.horizon or 12.0
+        samples = 6
+    else:
+        ras = [1500.0, 1600.0, 1700.0, 1800.0, 1900.0]
+        ny = args.ny or 33
+        horizon = args.horizon or 60.0
+        samples = 24
+
+    results = eigenmode_sweep(
+        ras, nx=8, ny=ny, dt=0.05, horizon=horizon, samples=samples,
+        run_dir=args.run_dir,
+    )
+    for r in results:
+        print(
+            f"Ra = {r['ra']:8.1f}   sigma_max = {r['sigma_max']:+.5f}   "
+            f"(modes {r['modes']}, {r['steps']} steps"
+            f"{', resumed' if r['resumed'] else ''})"
+        )
+    rac = critical_rayleigh(results)
+    err = abs(rac - RAC_RIGID) / RAC_RIGID
+    print(f"Ra_c = {rac:.1f}   (analytic {RAC_RIGID}, rel err {err:.2%})")
+    ok = err < 0.05
+    print("OK" if ok else "FAILED")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

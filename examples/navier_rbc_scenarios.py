@@ -1,0 +1,99 @@
+"""Scenario-axis demo: config-carried step modifiers + the vmapped
+solid-mask geometry sweep.
+
+Three workloads/modifiers.py scenarios on one small RBC cell:
+
+1. **passive scalar** — released equal to the temperature at matched
+   diffusivity, it must STAY equal (exact analytic validation of the new
+   transport term);
+2. **rotating frame** — f-plane Coriolis: in incompressible 2-D flow the
+   force is irrotational and absorbed by the pressure, so velocity and
+   temperature track the non-rotating run while the pressure shifts;
+3. **geometry sweep** — K solid-cylinder geometries advanced as ONE
+   vmapped donated scan, each bit-matching a solo set_solid run.
+
+Usage:  python examples/navier_rbc_scenarios.py [--quick]
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+from rustpde_mpi_tpu import Navier2D  # noqa: E402
+from rustpde_mpi_tpu.models.solid_masks import solid_cylinder_inner  # noqa: E402
+from rustpde_mpi_tpu.workloads import ScenarioConfig, geometry_sweep  # noqa: E402
+
+
+def build(nx, ny, scenario=None):
+    model = Navier2D(nx, ny, 1e4, 1.0, 0.01, 1.0, "rbc", periodic=False,
+                     scenario=scenario)
+    model.set_velocity(0.1, 1.0, 1.0)
+    model.set_temperature(0.1, 1.0, 1.0)
+    model.write_intervall = 1e9
+    return model
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true")
+    args = ap.parse_args()
+    nx = ny = 17 if args.quick else 65
+    steps = 30 if args.quick else 200
+    ok = True
+
+    # 1. passive scalar mirrors temperature (exact)
+    m = build(nx, ny, ScenarioConfig(passive_scalar=True))
+    m.set_field("scal", m.get_field("temp"))
+    m.update_n(steps)
+    drift = np.abs(m.get_field("scal") - m.get_field("temp")).max()
+    print(f"passive scalar: |c - T|_max = {drift:.3e} after {steps} steps "
+          f"(exact mirror at matched diffusivity)")
+    ok &= drift < 1e-10
+
+    # 2. rotating frame: pressure absorbs the Coriolis force
+    base = build(nx, ny)
+    rot = build(nx, ny, ScenarioConfig(coriolis=2.0))
+    base.update_n(steps)
+    rot.update_n(steps)
+
+    def rel(name):
+        a, b = base.get_field(name), rot.get_field(name)
+        return np.abs(a - b).max() / max(np.abs(a).max(), 1e-300)
+
+    print(f"rotating frame f=2: vel drift {max(rel('velx'), rel('vely')):.2e}, "
+          f"temp drift {rel('temp'):.2e}, PRESSURE drift {rel('pres'):.2e} "
+          f"(irrotational force -> absorbed by pressure)")
+    ok &= max(rel("velx"), rel("vely"), rel("temp")) < 1e-2 < rel("pres")
+
+    # 3. vmapped geometry sweep vs solo penalized runs
+    template = build(nx, ny)
+    xs, ys = (b.points for b in template.field_space.bases)
+    geoms = [
+        solid_cylinder_inner(xs, ys, 0.0, 0.0, 0.3),
+        solid_cylinder_inner(xs, ys, 0.4, -0.2, 0.2),
+        solid_cylinder_inner(xs, ys, -0.4, 0.3, 0.25),
+    ]
+    final, obs = geometry_sweep(template, geoms, min(steps, 10))
+    solo = build(nx, ny)
+    solo.set_solid(*geoms[0])
+    solo.update_n(min(steps, 10))
+    worst = max(
+        float(np.abs(np.asarray(getattr(final, n)[0])
+                     - np.asarray(getattr(solo.state, n))).max())
+        for n in ("temp", "velx", "vely")
+    )
+    print(f"geometry sweep: K={len(geoms)} obstacles in one vmapped scan, "
+          f"Nu per geometry = {[f'{v:.4f}' for v in obs[0]]}, "
+          f"member-0 vs solo set_solid max diff = {worst:.3e}")
+    ok &= worst < 1e-10
+
+    print("OK" if ok else "FAILED")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

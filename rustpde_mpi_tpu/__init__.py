@@ -46,11 +46,23 @@ from .utils.io_pipeline import (  # noqa: F401
     IOPipeline,
     ObservableFuture,
 )
+from .models.campaign import CampaignModelBase  # noqa: F401
 from .serve import (  # noqa: F401
     AdmissionError,
     RequestFailed,
     SimRequest,
     SimServer,
+)
+from .workloads import (  # noqa: F401
+    ScenarioConfig,
+    build_model,
+    critical_rayleigh,
+    eigenmode_sweep,
+    geometry_sweep,
+    model_kinds,
+    register_model_kind,
+    steady_state_find,
+    validate_campaign_model,
 )
 from .utils.checkpoint import CheckpointError  # noqa: F401
 from .utils.faults import FaultSpecError  # noqa: F401

@@ -294,6 +294,11 @@ class ServeConfig:
     slots: int = 8
     max_queue: int = 256
     chunk_steps: int = 256
+    # bucket fairness: max requests one campaign visit may claim while
+    # OTHER buckets hold queued work (0 = unlimited); with round-robin
+    # bucket selection this bounds any bucket's wait to one quantum per
+    # competitor instead of a hot bucket's whole backlog
+    bucket_quantum: int = 32
     checkpoint_every_s: float | None = 60.0
     request_max_retries: int = 2
     request_dt_backoff: float = 0.5
@@ -335,6 +340,11 @@ class NavierConfig:
     # stability-sentinel knobs (None = plain stepping; see StabilityConfig /
     # utils/governor.py) — from_config calls model.set_stability(stability)
     stability: StabilityConfig | None = None
+    # scenario step modifiers (None = plain physics; a
+    # workloads.modifiers.ScenarioConfig or equivalent dict: rotating-frame
+    # coriolis rate, passive_scalar, scalar_kappa) — baked into the step
+    # and signed into compat_key
+    scenario: object | None = None
 
     def ctor_args(self) -> tuple:
         return (self.nx, self.ny, self.ra, self.pr, self.dt, self.aspect, self.bc)

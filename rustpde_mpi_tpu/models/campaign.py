@@ -1,0 +1,657 @@
+"""The CampaignModel contract: what a physics model must provide to run
+under everything PRs 1–6 built — vmapped ensembles, the stability governor,
+elastic checkpoints, ``ResilientRunner`` and the ``SimServer`` scheduler.
+
+PRs 1–6 grew this contract ad hoc on :class:`~.navier.Navier2D`; this module
+makes it explicit so the rest of the reference's physics (``Navier2DLnse``,
+``Navier2DAdjoint``, scenario-modified DNS) plugs into the same serving and
+resilience stack.  The contract has two halves:
+
+**The protocol** (:data:`CAMPAIGN_MODEL_ATTRS`, checked by
+:func:`~rustpde_mpi_tpu.workloads.registry.validate_campaign_model`):
+
+* a ``state`` pytree (NamedTuple of device arrays) threaded through a pure
+  jitted step,
+* hoisted entry points — ``_step_cc``/``_step_consts`` and
+  ``_obs_cc``/``_obs_consts`` (the closure-converted step and observables
+  jaxprs the ensemble engine re-vmaps; one physics code path, batch as a
+  leading axis),
+* ``update_n`` with the in-chunk early-exit, ``update_n_pending`` (the
+  lag=1 deferred-commit sentinel chunk of the overlapped driver), and
+  ``set_stability`` compiling on-device sentinels into the scanned chunk,
+* ``set_dt`` with per-rung artifact caching (bounded re-jits under a
+  governor ladder),
+* ``compat_key`` — the operator-constant bucket key, now prefixed with the
+  model kind so mixed-model campaigns bucket correctly,
+* observable futures (``get_observables_async``) with per-model
+  ``observable_names``,
+* the sharded-snapshot surface (``snapshot_state_items`` /
+  ``snapshot_root_items`` / ``apply_restored_state``) plus ``read``/``write``.
+
+**The machinery** (:class:`CampaignModelBase`): everything in that list that
+is generic over the step function is implemented HERE, once — the scanned
+chunk with divergence early-exit and buffer donation, the sentinel-armed
+variant, the deferred-commit pending chunk, the dt-rung cache, the cached
+observable future, exit/exit_future.  A model supplies the physics hooks:
+
+* ``_make_step(with_sentinels=False)`` — the pure step (with the optional
+  ``(cfl, ke, div)`` sentinel tuple),
+* ``_make_observables()`` — the fused per-state scalar diagnostics,
+* ``_state_example()`` — ShapeDtypeStructs of one state,
+* ``_scan_ok(state)`` — the in-scan continue criterion (default: temp is
+  finite; the steady-state finder additionally stops on residual
+  convergence — the residual-based exit sentinel),
+* ``_rebuild_dt_artifacts()`` — rebuild whatever a dt change invalidates.
+
+``Navier2D`` inherits this base (its PR 1–4 behavior is unchanged — the
+code moved, the traced programs did not), and ``Navier2DLnse`` /
+``Navier2DAdjoint`` ride the same machinery instead of hand-rolled loops.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .. import config
+
+#: the attribute surface the workloads registry validates a campaign model
+#: against (see module docstring) — kept as data so the check and the docs
+#: cannot drift apart
+CAMPAIGN_MODEL_ATTRS = (
+    "MODEL_KIND",
+    "observable_names",
+    "state",
+    "compat_key",
+    "update_n",
+    "update_n_pending",
+    "set_stability",
+    "clear_pre_divergence",
+    "set_dt",
+    "get_dt",
+    "get_time",
+    "get_observables_async",
+    "exit",
+    "exit_future",
+    "state_healthy",
+    "init_random",
+    "snapshot_state_items",
+    "snapshot_root_items",
+    "apply_restored_state",
+    "read",
+    "write",
+    "_step_cc",
+    "_step_consts",
+    "_obs_cc",
+    "_obs_consts",
+    "_make_step",
+    "_make_observables",
+    "_scan_ok",
+    "_scope",
+)
+
+
+class CampaignModelBase:
+    """Generic campaign-model machinery (see module docstring).
+
+    Subclasses must call :meth:`_init_campaign` early in ``__init__`` (before
+    :meth:`_compile_entry_points`) and provide the physics hooks."""
+
+    #: registry kind prefix of :attr:`compat_key` (per subclass)
+    MODEL_KIND = "model"
+    #: names of the four scalars ``_make_observables`` returns, in order;
+    #: index 3 is by convention the NaN detector (a divergence norm)
+    observable_names = ("obs0", "obs1", "obs2", "div")
+
+    # overlapped-IO hooks (utils/io_pipeline.py): an attached IOPipeline
+    # routes callback IO through the background writer / lag queue, and
+    # io_overlap opts the chunked driver into lagged break checks
+    # (utils/integrate.py).  Class-level defaults keep plain models fully
+    # synchronous.
+    io_pipeline = None
+    io_overlap = False
+
+    # -- construction-time bookkeeping ---------------------------------------
+
+    def _init_campaign(self) -> None:
+        self.time = 0.0
+        self._obs_cache: tuple | None = None
+        # stability sentinels (utils/governor.py): None = plain stepping
+        self._stability = None
+        self.last_chunk_status = None
+        self._pre_div_latch = False
+        # per-rung cache of dt-baked artifacts (solvers + compiled entry
+        # points), so a governor cycling a bounded dt ladder re-jits each
+        # rung at most once; recompile_count tracks actual rebuilds
+        self._dt_cache: dict[float, dict] = {}
+        self.recompile_count = 0
+
+    # -- physics hooks (per subclass) ----------------------------------------
+
+    def _make_step(self, with_sentinels: bool = False):
+        raise NotImplementedError
+
+    def _make_observables(self):
+        raise NotImplementedError
+
+    def _state_example(self):
+        """ShapeDtypeStruct pytree of one state (hoisting example)."""
+        raise NotImplementedError
+
+    def _scan_ok(self, state):
+        """In-scan continue criterion over a (traced) state: keep stepping
+        while True.  The default is the PR-1 divergence detector — temp is
+        finite (a NaN anywhere infects temp within one step via buoyancy/
+        convection).  The steady-state finder overrides this with
+        ``finite AND residual > tol`` so convergence freezes the member
+        inside the chunk — the residual-based exit sentinel."""
+        import jax.numpy as jnp
+
+        return jnp.isfinite(jnp.sum(state.temp))
+
+    def _scan_done_ok(self, state):
+        """True when a member that STOPPED advancing (``_scan_ok`` False)
+        stopped *successfully* (converged) rather than by divergence.
+        Default: stopping is always a failure (the DNS semantics)."""
+        import jax.numpy as jnp
+
+        del state
+        return jnp.asarray(False)
+
+    def _scan_commit_ok(self, state):
+        """Is a CANDIDATE stepped state worth committing?  The ensemble's
+        per-member freeze keeps the previous state when this is False (the
+        NaN-isolation semantics: never commit a poisoned state).  Default:
+        same as ``_scan_ok`` — but a model whose ``_scan_ok`` also stops on
+        SUCCESS (the adjoint finder's convergence) overrides this to plain
+        finiteness, so the converged state IS committed before the member
+        freezes (discarding it would pin the member one step shy of its
+        answer forever)."""
+        return self._scan_ok(state)
+
+    def _gspmd_split_sep_fallback(self) -> bool:
+        """True when the fused jitted chunk must be avoided (the GSPMD
+        split-sep miscompile guard — see Navier2D); the base assumes no
+        such poisoned layout."""
+        return False
+
+    def restart_fill(self, name: str, like):
+        """Fill value for a state leaf a gathered (restart-equivalent)
+        snapshot does not carry — default zero; override for leaves whose
+        pristine value is not zero (the adjoint's residual norms)."""
+        import jax.numpy as jnp
+
+        del name
+        return jnp.zeros_like(like)
+
+    def _rebuild_dt_artifacts(self) -> None:
+        """Rebuild everything ``self.dt`` is baked into (solvers, lift
+        fields, compiled entry points) — called by :meth:`set_dt` on a
+        cache-miss rung, AFTER ``self.dt`` was updated."""
+        self._compile_entry_points()
+
+    def _dt_changed(self, dt: float) -> None:
+        """Propagation hook run on EVERY dt change (cache hit or miss),
+        before artifacts are restored/rebuilt — a wrapper model syncs its
+        embedded model here (``Navier2DLnse`` -> inner ``Navier2D``)."""
+
+    # -- sharding helpers ----------------------------------------------------
+
+    def _scope(self):
+        """Activate this model's mesh for the duration of a trace/dispatch."""
+        from ..parallel.mesh import use_mesh
+
+        if getattr(self, "mesh", None) is None:
+            import contextlib
+
+            return contextlib.nullcontext()
+        return use_mesh(self.mesh)
+
+    def _place(self, arr):
+        """Put a spectral array into x-pencil layout under the mesh."""
+        from ..parallel.mesh import SPEC, device_put
+
+        return device_put(arr, SPEC)
+
+    # -- compiled entry points ------------------------------------------------
+
+    def _compile_entry_points(self) -> None:
+        """Hoist + jit the step/observables entry points (see Navier2D's
+        original docstring: closure-converted constants keep the HLO small
+        at large grids) and build the chunked ``step_n`` with the in-chunk
+        early-exit and buffer donation."""
+        import jax
+        import jax.numpy as jnp
+
+        from ..utils.jit import hoist_constants
+
+        example = self._state_example()
+        self.recompile_count += 1
+        self._sent_cc = None
+        self._sent_consts = None
+        self._step_n_sent = None
+        with self._scope():
+            step_cc, step_consts = hoist_constants(self._make_step(), example)
+            obs_cc, obs_consts = hoist_constants(self._make_observables(), example)
+        self._step_consts = step_consts
+        self._obs_consts = obs_consts
+        # retained for the ensemble engine (models/ensemble.py): the SAME
+        # traced jaxpr is vmapped over a leading member axis there — one
+        # physics code path, batch as a leading axis, no forked step
+        self._step_cc = step_cc
+        self._obs_cc = obs_cc
+
+        if self._gspmd_split_sep_fallback():
+            self._compile_eager_entry_points()
+            return
+
+        step_jit = jax.jit(step_cc)
+        self._step = lambda s: step_jit(self._step_consts, s)
+
+        def step_n(consts, state, n: int):
+            """n scanned steps with in-chunk early-exit: a continue flag
+            (``_scan_ok`` — is-finite for the DNS, finite-and-unconverged
+            for the steady finder) rides the carry, and once it drops the
+            remaining iterations take the identity branch of a ``lax.cond``
+            — the device stops paying for GEMMs mid-chunk.  Returns
+            ``(state, steps_done)``."""
+
+            def advance(carry):
+                st, _, done = carry
+                st2 = step_cc(consts, st)
+                ok2 = self._scan_ok(st2)
+                return st2, ok2, done + 1
+
+            def body(carry, _):
+                carry2 = jax.lax.cond(carry[1], advance, lambda c: c, carry)
+                return carry2, None
+
+            init = (state, jnp.asarray(True), jnp.asarray(0, jnp.int32))
+            (final, _, done), _ = jax.lax.scan(body, init, None, length=n)
+            return final, done
+
+        # donate the state: XLA aliases the input coefficient buffers to the
+        # scan carry's outputs, so a chunked dispatch updates the state in
+        # place instead of holding a second resident copy in HBM.  Callers
+        # must hand in buffers they no longer need — update_n dispatches a
+        # fresh copy first, keeping references retained to ``self.state``
+        # across the call valid (no use-after-donate on the public API).
+        step_n_jit = jax.jit(step_n, static_argnames=("n",), donate_argnums=(1,))
+        self._step_n = lambda s, n: step_n_jit(self._step_consts, s, n=n)
+        obs_jit = jax.jit(obs_cc)
+        self._obs_fn = lambda s: obs_jit(self._obs_consts, s)
+
+        if self._stability is not None:
+            self._compile_sentinel_entry_points(example)
+
+    def _compile_eager_entry_points(self) -> None:
+        """Per-stage eager fallback (the GSPMD split-sep miscompile guard):
+        slow but right; same early-exit semantics as the scanned fast path
+        (the state that first failed ``_scan_ok`` is kept, later steps are
+        identity)."""
+        import jax.numpy as jnp
+
+        step_fn = self._make_step()
+        obs_fn = self._make_observables()
+        self._step = step_fn
+
+        def step_n_eager(state, n):
+            done = 0
+            for _ in range(int(n)):
+                state = step_fn(state)
+                done += 1
+                if not bool(self._scan_ok(state)):
+                    break
+            return state, jnp.asarray(done, jnp.int32)
+
+        self._step_n = step_n_eager
+        self._obs_fn = obs_fn
+
+    def _compile_sentinel_entry_points(self, example) -> None:
+        """Sentinel variant of the scanned chunk (set_stability): the carry
+        additionally holds a CFL-ok flag and running sentinel reductions, and
+        the early-exit fires on EITHER a failed ``_scan_ok`` (the NaN path)
+        or a per-step CFL above ``max_cfl`` — the *pre-divergence* catch,
+        taken while the state is still finite so the chunk can be recovered
+        by an in-memory rollback instead of a checkpoint restore."""
+        import jax
+        import jax.numpy as jnp
+
+        from ..utils.jit import hoist_constants
+
+        with self._scope():
+            sent_cc, sent_consts = hoist_constants(
+                self._make_step(with_sentinels=True), example
+            )
+        self._sent_cc = sent_cc
+        self._sent_consts = sent_consts
+        ceiling = float(self._stability.max_cfl)
+
+        def step_n_sent(consts, carry, n: int):
+            def advance(carry):
+                st, fin, cok, done, cflm, gm, dvm, kep = carry
+                st2, (cfl, ke, dv) = sent_cc(consts, st)
+                fin2 = self._scan_ok(st2)
+                # NaN cfl must read as the NaN path, not a ceiling trip:
+                # NaN > ceiling is False, so ~(cfl > ceiling) stays True
+                cok2 = jnp.logical_not(cfl > ceiling)
+                growth = jnp.where(kep > 0.0, ke / kep, 1.0)
+                return (
+                    st2,
+                    fin2,
+                    cok2,
+                    done + 1,
+                    jnp.maximum(cflm, cfl),
+                    jnp.maximum(gm, growth),
+                    jnp.maximum(dvm, dv),
+                    ke,
+                )
+
+            def body(carry, _):
+                carry2 = jax.lax.cond(
+                    carry[1] & carry[2], advance, lambda c: c, carry
+                )
+                return carry2, None
+
+            final, _ = jax.lax.scan(body, carry, None, length=n)
+            return final
+
+        sent_jit = jax.jit(step_n_sent, static_argnames=("n",), donate_argnums=(1,))
+        self._step_n_sent = lambda c, n: sent_jit(self._sent_consts, c, n=n)
+
+    # -- Integrate protocol ---------------------------------------------------
+
+    def update(self) -> None:
+        with self._scope():
+            self.state = self._step(self.state)
+        self.time += self.dt
+
+    def update_n(self, n: int):
+        """Advance n steps on the device via scanned power-of-two chunks
+        (utils/jit.run_scanned).  Dispatches stay asynchronous and donate
+        their input state buffers; on divergence the in-scan early exit
+        freezes the state, ``exit()`` reports it at the next chunk boundary,
+        and ``self.time`` deliberately counts the scheduled steps.
+
+        With stability sentinels armed (:meth:`set_stability`) the chunk
+        additionally returns a
+        :class:`~rustpde_mpi_tpu.utils.governor.ChunkStatus` (also stored as
+        ``self.last_chunk_status``): a per-step CFL above the hard ceiling
+        early-exits the scan with ``pre_divergence`` while the state is
+        still finite, the chunk is rolled back in memory and ``exit()``
+        latches True until a governor acknowledges
+        (:meth:`clear_pre_divergence`)."""
+        import jax
+        import jax.numpy as jnp
+
+        from ..utils.jit import run_scanned
+
+        if self._step_n_sent is not None:
+            return self._update_n_sentinel(n)
+        with self._scope():
+            # the chunked dispatch donates its input buffers; hand it a copy
+            # so a state reference the caller retained stays readable, while
+            # every inter-bucket hand-off inside the chain is donated
+            state = jax.tree.map(jnp.copy, self.state)
+            self.state = run_scanned(lambda s, k: self._step_n(s, k)[0], state, n)
+        self.time += n * self.dt
+        return None
+
+    def _update_n_sentinel(self, n: int):
+        """Sentinel-armed chunk: scan with CFL/KE/|div| reductions riding the
+        carry, one scalar fetch at the end (the only extra host sync)."""
+        return self.update_n_pending(n).resolve()
+
+    def update_n_pending(self, n: int):
+        """Sentinel-armed chunk with a DEFERRED commit decision (the lag=1
+        contract of the overlapped driver, utils/io_pipeline.py): dispatch
+        the scanned chunk, PROVISIONALLY advance ``state``/``time`` to its
+        end, and return a
+        :class:`~rustpde_mpi_tpu.utils.io_pipeline.PendingChunkStatus` whose
+        ``resolve()`` fetches the sentinel scalars and either confirms the
+        advance or restores the chunk-start snapshot (+ latches ``exit()``)
+        — exactly the synchronous :meth:`update_n` outcome, decided one host
+        round-trip later."""
+        import jax
+        import jax.numpy as jnp
+
+        from ..utils.governor import ChunkStatus
+        from ..utils.io_pipeline import PendingChunkStatus
+        from ..utils.jit import run_scanned
+
+        if self._step_n_sent is None:
+            raise RuntimeError(
+                "update_n_pending requires armed stability sentinels "
+                "(set_stability)"
+            )
+        self._pre_div_latch = False
+        rdt = config.real_dtype()
+        with self._scope():
+            state = jax.tree.map(jnp.copy, self.state)
+            carry = (
+                state,
+                jnp.asarray(True),
+                jnp.asarray(True),
+                jnp.asarray(0, jnp.int32),
+                jnp.asarray(0.0, rdt),  # cfl max
+                jnp.asarray(0.0, rdt),  # ke growth max
+                jnp.asarray(0.0, rdt),  # |div| max
+                jnp.asarray(0.0, rdt),  # previous-step ke
+            )
+            carry = run_scanned(lambda c, k: self._step_n_sent(c, k), carry, n)
+        st, fin, cok, done, cflm, gm, dvm, ke = carry
+        snapshot = (self.state, self.time)
+        self.state = st  # provisional: resolve() confirms or restores
+        self.time += n * self.dt
+        dt = self.dt
+
+        def finish(fetched):
+            fin_h, cok_h, done_h, cflm_h, gm_h, dvm_h, ke_h = fetched
+            fin_b, cok_b = bool(fin_h), bool(cok_h)
+            pre_div = fin_b and not cok_b
+            if pre_div:
+                # in-memory rollback: the dispatch stepped a donated COPY,
+                # so the snapshot still holds the chunk-start state — put it
+                # back and latch exit() until a governor acts
+                self.state, self.time = snapshot
+                self._pre_div_latch = True
+            status = ChunkStatus(
+                requested=int(n),
+                steps_done=int(done_h),
+                finite=fin_b,
+                cfl_ok=cok_b,
+                pre_divergence=pre_div,
+                cfl_max=float(cflm_h),
+                ke=float(ke_h),
+                ke_growth_max=float(gm_h),
+                div_max=float(dvm_h),
+                dt=dt,
+            )
+            self.last_chunk_status = status
+            return status
+
+        return PendingChunkStatus((fin, cok, done, cflm, gm, dvm, ke), finish)
+
+    def set_stability(self, cfg) -> None:
+        """Arm/disarm (``None``) the on-device stability sentinels
+        (:class:`~rustpde_mpi_tpu.config.StabilityConfig`): compiles the
+        sentinel variant of the scanned chunk into :meth:`update_n`.  Under
+        the GSPMD split-sep fallback the sentinel path is unavailable and
+        stepping stays plain (a one-time warning is emitted)."""
+        self._stability = cfg
+        self._dt_cache.clear()  # cached artifacts lack/stale sentinel entries
+        self._compile_entry_points()
+        if cfg is not None and self._step_n_sent is None:
+            import warnings
+
+            warnings.warn(
+                "stability sentinels are not available on the per-stage "
+                "eager GSPMD fallback path; stepping stays plain",
+                RuntimeWarning,
+                stacklevel=2,
+            )
+        self.last_chunk_status = None
+        self._pre_div_latch = False
+
+    def clear_pre_divergence(self) -> None:
+        """Acknowledge a ``pre_divergence`` catch (the governor changed dt /
+        killed members and wants the chunk retried): unlatch ``exit()``."""
+        self._pre_div_latch = False
+
+    def get_time(self) -> float:
+        return self.time
+
+    def get_dt(self) -> float:
+        return self.dt
+
+    def reset_time(self) -> None:
+        self.time = 0.0
+
+    # -- dt rung cache --------------------------------------------------------
+
+    #: attributes a dt change swaps out, cached per rung so a governor
+    #: cycling a bounded dt ladder re-jits each rung ONCE (per subclass —
+    #: extend with whatever else dt is baked into)
+    _DT_ARTIFACTS = (
+        "_step",
+        "_step_n",
+        "_obs_fn",
+        "_step_cc",
+        "_obs_cc",
+        "_step_consts",
+        "_obs_consts",
+        "_sent_cc",
+        "_sent_consts",
+        "_step_n_sent",
+    )
+
+    def _dt_artifacts(self) -> dict:
+        return {k: getattr(self, k, None) for k in self._DT_ARTIFACTS}
+
+    def set_dt(self, dt: float) -> None:
+        """Change the time-step size of a live model (the governor's dt
+        ladder and the divergence-retry backoff).
+
+        dt is baked deep into the pipeline, so a FIRST visit to a dt
+        rebuilds the dt-baked artifacts (:meth:`_rebuild_dt_artifacts`) and
+        re-traces the jitted entry points.  Every artifact is then cached
+        per dt value, so revisiting a rung swaps the cached objects back in
+        — the retained jit closures keep their identity, so XLA's executable
+        cache hits and the total re-jit count over a long governed run is
+        bounded by the ladder size.  State and time are untouched either
+        way: the run continues from the same fields at the new step size."""
+        dt = float(dt)
+        if dt <= 0.0:
+            raise ValueError(f"dt must be positive, got {dt}")
+        if dt == self.dt:
+            return
+        self._dt_cache[self.dt] = self._dt_artifacts()
+        self.dt = dt
+        self._dt_changed(dt)
+        cached = self._dt_cache.get(dt)
+        if cached is not None:
+            for key, value in cached.items():
+                setattr(self, key, value)
+            self._obs_cache = None
+            return
+        self._rebuild_dt_artifacts()
+        self._obs_cache = None
+
+    # -- observables / exit ---------------------------------------------------
+
+    def get_observables_async(self):
+        """Dispatch the fused observables computation and return an
+        :class:`~rustpde_mpi_tpu.utils.io_pipeline.ObservableFuture` WITHOUT
+        waiting for it — the device keeps working while the host decides
+        when (if ever) to fetch.  Cached per state, shared with the
+        synchronous accessors and :meth:`exit_future`, so diagnostics +
+        break checks cost ONE dispatch and ONE host transfer per state."""
+        from ..utils.io_pipeline import ObservableFuture
+
+        if self._obs_cache is None or self._obs_cache[0] is not self.state:
+            with self._scope():
+                fut = ObservableFuture(
+                    self._obs_fn(self.state),
+                    convert=lambda vals: tuple(float(v) for v in vals),
+                )
+            self._obs_cache = (self.state, fut)
+        return self._obs_cache[1]
+
+    def get_observables(self) -> tuple[float, float, float, float]:
+        """The four per-model scalars (:attr:`observable_names`) — one fused
+        device dispatch, cached per state, fetched in ONE host transfer."""
+        return self.get_observables_async().result()
+
+    def div_norm(self) -> float:
+        """The NaN-detector observable (index 3 by convention)."""
+        return self.get_observables()[3]
+
+    def exit(self) -> bool:
+        """NaN-divergence break criterion, extended by the pre-divergence
+        latch: a CFL-ceiling catch (sentinels armed) reads as a break until
+        a governor clears it."""
+        if self._pre_div_latch:
+            return True
+        return bool(np.isnan(self.div_norm()))
+
+    def exit_future(self):
+        """Non-blocking form of :meth:`exit` for the overlapped driver
+        (utils/integrate.py ``overlap``): a latched pre-divergence catch
+        resolves immediately (host-side fact); otherwise the break flag
+        rides the cached observables dispatch."""
+        from ..utils.io_pipeline import MappedFuture, immediate
+
+        if self._pre_div_latch:
+            return immediate(True)
+        return MappedFuture(
+            self.get_observables_async(), lambda vals: bool(np.isnan(vals[3]))
+        )
+
+    def state_healthy(self) -> bool:
+        """Is the current state worth checkpointing?  Distinct from
+        :meth:`exit`: a steady-state finder that CONVERGED exits the run
+        loop but its state is the answer, not a corpse.  The resilient
+        runner consults this before every checkpoint."""
+        if self._pre_div_latch:
+            return False
+        return bool(np.isfinite(self.div_norm()))
+
+    # -- sharded (shard-wise) snapshot surface --------------------------------
+
+    def snapshot_state_items(self) -> list:
+        """``(name, device_array)`` for every state leaf the sharded
+        checkpoint must carry — the full restart set, generic over the
+        state NamedTuple."""
+        return [
+            (f"state/{name}", getattr(self.state, name))
+            for name in self.state._fields
+        ]
+
+    def snapshot_root_items(self) -> list:
+        """Replicated host-side data for the sharded manifest root."""
+        items = [("time", np.asarray(float(self.time), dtype=np.float64), "raw")]
+        for key, value in getattr(self, "params", {}).items():
+            items.append((key, np.asarray(float(value), dtype=np.float64), "raw"))
+        return items
+
+    def apply_restored_state(self, updates: dict, attrs: dict, root: dict) -> None:
+        """Install state leaves assembled by the sharded reader (already
+        placed in this model's target layout) + the manifest's time."""
+        self.state = self.state._replace(**updates)
+        self.time = float(np.asarray(root["time"]))
+        self._obs_cache = None
+        self._pre_div_latch = False
+
+    # -- compatibility bucketing ----------------------------------------------
+
+    def _compat_fields(self) -> tuple:
+        """Everything (beyond the model kind) baked into the compiled step —
+        per subclass."""
+        raise NotImplementedError
+
+    @property
+    def compat_key(self) -> tuple:
+        """Operator-constant bucket key, prefixed with the model kind: two
+        requests/models with equal keys share one compiled (vmapped) step
+        jaxpr — the serve scheduler buckets by this; anything differing
+        forces a fresh model build + compile."""
+        return (str(self.MODEL_KIND),) + tuple(self._compat_fields())

@@ -60,13 +60,13 @@ class NavierEnsemble(Integrate):
     io_pipeline = None
     io_overlap = False
 
-    def __init__(self, model: Navier2D, states):
-        if isinstance(states, NavierState):
+    def __init__(self, model, states):
+        if hasattr(states, "_fields"):  # a state pytree, maybe pre-stacked
             if np.ndim(states.temp) != np.ndim(model.state.temp) + 1:
                 raise TypeError(
                     "NavierEnsemble expects a sequence of member states or a "
-                    "NavierState whose leaves carry a leading K axis; got an "
-                    "unbatched NavierState — wrap it in a list for K=1"
+                    "state pytree whose leaves carry a leading K axis; got "
+                    "an unbatched state — wrap it in a list for K=1"
                 )
             stacked = states
         else:
@@ -187,7 +187,7 @@ class NavierEnsemble(Integrate):
             self.state = jax.tree.map(
                 lambda st, leaf: st.at[i].set(leaf), self.state, state
             )
-            self.mask = self.mask.at[i].set(jnp.isfinite(jnp.sum(state.temp)))
+            self.mask = self.mask.at[i].set(self.model._scan_ok(state))
             self.steps_done = self.steps_done.at[i].set(0)
         self._obs_cache = None
 
@@ -199,13 +199,38 @@ class NavierEnsemble(Integrate):
 
     # -- the batched step ----------------------------------------------------
 
-    def _finite_mask(self, stacked: NavierState):
-        """Per-member is-finite over temp — the same one-reduction detector
-        the single-run early-exit uses (a NaN anywhere infects temp within
-        one step via buoyancy/convection, models/navier.py)."""
-        return jnp.isfinite(
-            jnp.sum(stacked.temp, axis=tuple(range(1, stacked.temp.ndim)))
-        )
+    def _finite_mask(self, stacked):
+        """Per-member continue criterion — the template model's ``_scan_ok``
+        vmapped over the member axis.  For the DNS that is the one-reduction
+        is-finite detector (a NaN anywhere infects temp within one step via
+        buoyancy/convection); the steady-state adjoint additionally drops a
+        member on residual CONVERGENCE, so a frozen member there may be a
+        finished one, not a corpse (``done_ok_members`` tells them apart)."""
+        return jax.vmap(self.model._scan_ok)(stacked)
+
+    def done_ok_members(self) -> np.ndarray:
+        """Per-member successfully-finished mask (host bools): members that
+        stopped advancing via the model's *success* criterion (e.g. the
+        adjoint finder's residual convergence) rather than by divergence."""
+        with self.model._scope():
+            done = jax.vmap(self.model._scan_done_ok)(self.state)
+        return np.asarray(done)
+
+    def state_healthy(self) -> bool:
+        """Checkpoint guard (utils/resilience._state_ok): an ensemble is
+        worth checkpointing while any member is still advancing OR any
+        member finished successfully — but an all-dead batch must never
+        overwrite the rollback target."""
+        if self._pre_div_latch:
+            return False
+        if bool(np.any(self.alive())):
+            return True
+        return bool(self.done_ok_members().any())
+
+    @property
+    def observable_names(self) -> tuple:
+        """The template model's observable vocabulary (shape (K,) each)."""
+        return self.model.observable_names
 
     def _compile_entry_points(self) -> None:
         model = self.model
@@ -238,10 +263,10 @@ class NavierEnsemble(Integrate):
                     st = members[i]
                     for _ in range(int(n)):
                         cand = step_fn(st)
-                        if bool(jnp.isfinite(jnp.sum(cand.temp))):
+                        if bool(self.model._scan_commit_ok(cand)):
                             st = cand
                             counts[i] += 1
-                        else:
+                        if not bool(self.model._scan_ok(cand)):
                             alive[i] = False
                             break
                     members[i] = st
@@ -272,17 +297,27 @@ class NavierEnsemble(Integrate):
             ``lax.cond`` (the single-run early-exit, batch-wide)."""
 
             vstep = jax.vmap(lambda s: step_cc(consts, s))
+            vcommit = jax.vmap(self.model._scan_commit_ok)
 
             def advance(carry):
                 st, ok, dn = carry
                 st2 = vstep(st)
+                # commit any candidate the model deems valid (finite; for
+                # the DNS identical to the continue mask), CONTINUE only
+                # while _scan_ok holds — the adjoint finder's converged
+                # state commits on its final step before the freeze
+                commit = ok & vcommit(st2)
                 ok2 = ok & self._finite_mask(st2)
 
                 def freeze(new, old):
-                    sel = jnp.reshape(ok2, ok2.shape + (1,) * (new.ndim - 1))
+                    sel = jnp.reshape(commit, commit.shape + (1,) * (new.ndim - 1))
                     return jnp.where(sel, new, old)
 
-                return jax.tree.map(freeze, st2, st), ok2, dn + ok2.astype(jnp.int32)
+                return (
+                    jax.tree.map(freeze, st2, st),
+                    ok2,
+                    dn + commit.astype(jnp.int32),
+                )
 
             def body(carry, _):
                 carry2 = jax.lax.cond(jnp.any(carry[1]), advance, lambda c: c, carry)
@@ -323,6 +358,7 @@ class NavierEnsemble(Integrate):
 
         def ens_step_n_sent(consts, carry, n: int):
             vstep = jax.vmap(lambda s: sent_cc(consts, s))
+            vcommit = jax.vmap(model._scan_commit_ok)
 
             def advance(carry):
                 st, fin, cok, dn, cflm, gm, dvm, kep = carry
@@ -330,7 +366,9 @@ class NavierEnsemble(Integrate):
                 active = fin & cok
                 fin2 = jnp.where(active, self._finite_mask(st2), fin)
                 cok2 = jnp.where(active, jnp.logical_not(cfl > ceiling), cok)
-                keep = active & fin2 & cok2
+                # commit-vs-continue split, as in the plain chunk: a
+                # convergence-stopped member's final state still commits
+                keep = active & vcommit(st2) & cok2
 
                 def freeze(new, old):
                     sel = jnp.reshape(keep, keep.shape + (1,) * (new.ndim - 1))

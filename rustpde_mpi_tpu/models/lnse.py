@@ -34,7 +34,9 @@ import jax.numpy as jnp
 import numpy as np
 
 from .. import config
+from ..field import norm_l2
 from ..utils.integrate import Integrate
+from .campaign import CampaignModelBase
 from .meanfield import MeanFields
 from .navier import Navier2D, NavierState
 
@@ -48,9 +50,20 @@ def l2_norm(a1, a2, b1, b2, c1, c2, beta1: float, beta2: float):
     return 0.5 * jnp.sum(beta1 * (a1 * a2 + b1 * b2) + beta2 * (c1 * c2))
 
 
-class Navier2DLnse(Integrate):
+class Navier2DLnse(CampaignModelBase, Integrate):
     """Linearized NSE about a mean field; Navier2D parameter vocabulary plus
-    ``mean`` (defaults to the analytic bc profile)."""
+    ``mean`` (defaults to the analytic bc profile).
+
+    A full campaign model (models/campaign.py): the direct step is hoisted
+    into ``_step_cc`` so eigenmode sweeps run as vmapped
+    :class:`~rustpde_mpi_tpu.models.ensemble.NavierEnsemble` batches under
+    ``ResilientRunner`` and the serve scheduler — observables are the
+    perturbation energies ``(energy, ke, te, div)``, whose chunk-boundary
+    trajectory the eigenmode workload fits growth rates from
+    (workloads/eigenmodes.py)."""
+
+    MODEL_KIND = "lnse"
+    observable_names = ("energy", "ke", "te", "div")
 
     #: include the perturbation self-convection + mean-balance terms
     NONLINEAR = False
@@ -77,15 +90,75 @@ class Navier2DLnse(Integrate):
                 f"{self.navier.field_space.shape_physical}"
             )
         self.mean = mean
+        self.mesh = mesh
         self.dt = dt
-        self.time = 0.0
         self.params = self.navier.params
         self.scale = self.navier.scale
         self.write_intervall: float | None = None
         self.statistics = None
-        self._obs_cache = None
+        self._init_campaign()
         self._compile_entry_points()
         self.state = NavierState(*self.navier.state)
+
+    @property
+    def nx(self) -> int:
+        return self.navier.nx
+
+    @property
+    def ny(self) -> int:
+        return self.navier.ny
+
+    # space delegates (checkpoint layer vocabulary)
+    @property
+    def temp_space(self):
+        return self.navier.temp_space
+
+    @property
+    def velx_space(self):
+        return self.navier.velx_space
+
+    @property
+    def vely_space(self):
+        return self.navier.vely_space
+
+    @property
+    def pres_space(self):
+        return self.navier.pres_space
+
+    @property
+    def pseu_space(self):
+        return self.navier.pseu_space
+
+    @property
+    def field_space(self):
+        return self.navier.field_space
+
+    @property
+    def x(self):
+        return self.navier.x
+
+    def _compat_fields(self) -> tuple:
+        return (
+            int(self.navier.nx),
+            int(self.navier.ny),
+            float(self.params["ra"]),
+            float(self.params["pr"]),
+            float(self.dt),
+            float(self.scale[0]),
+            str(self.navier.bc),
+            bool(self.navier.periodic),
+            (),  # scenario slot (modifiers are a DNS axis)
+        )
+
+    def _gspmd_split_sep_fallback(self) -> bool:
+        return self.navier._gspmd_split_sep_fallback()
+
+    def _state_example(self):
+        nav = self.navier
+        return jax.tree.map(
+            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype),
+            NavierState(*nav.state[:5]),
+        )
 
     @classmethod
     def new_confined(cls, nx, ny, ra, pr, dt, aspect, bc, mean=None, mesh=None):
@@ -123,11 +196,18 @@ class Navier2DLnse(Integrate):
 
     # -- direct (forward) step ------------------------------------------------
 
-    def _make_direct_step(self):
+    def _make_step(self, with_sentinels: bool = False):
+        """The linearized step; ``with_sentinels=True`` additionally returns
+        ``(cfl, ke, |div|)`` — the advective CFL uses the TOTAL velocity
+        (mean + perturbation: the mean advects the perturbation, so it
+        bounds the explicit convection's stability), ke is the perturbation
+        kinetic energy, |div| the pre-projection residual."""
         nav = self.navier
         dt = self.dt
         scale = self.scale
         nu, ka = self.params["nu"], self.params["ka"]
+        inv_dx, inv_dy = nav._inv_dx, nav._inv_dy
+        w0s, w1s = nav._w0, nav._w1
         sp_t, sp_u, sp_v = nav.temp_space, nav.velx_space, nav.vely_space
         sp_p, sp_q, sp_f = nav.pres_space, nav.pseu_space, nav.field_space
         from ..bases import fused_projection_gradient
@@ -184,6 +264,17 @@ class Navier2DLnse(Integrate):
                 that = that + that_mean  # buoyancy incl. base state
             ux = sp_u.backward(velx)
             uy = sp_v.backward(vely)
+
+            if with_sentinels:
+                # advective CFL of the TOTAL velocity (mean + perturbation)
+                # + perturbation KE, from arrays the step needs anyway
+                cfl = dt * jnp.max(
+                    jnp.abs(mc["U"] + ux) * inv_dx[:, None]
+                    + jnp.abs(mc["V"] + uy) * inv_dy[None, :]
+                )
+                ke = 0.5 * jnp.sum(
+                    (ux**2 + uy**2) * w0s[:, None] * w1s[None, :]
+                )
 
             # linearized convection: u.grad(U) + U.grad(u) (lnse_eq.rs:59-110)
             du_dx = gphys(sp_u, velx, (1, 0))
@@ -242,9 +333,36 @@ class Navier2DLnse(Integrate):
                 rhs = rhs + dt * ka * lap_t_m
             temp_n = sol_t.solve(rhs)
 
-            return NavierState(temp_n, velx_n, vely_n, pres_n, pseu_n)
+            state_n = NavierState(temp_n, velx_n, vely_n, pres_n, pseu_n)
+            if with_sentinels:
+                return state_n, (cfl, ke, norm_l2(div))
+            return state_n
 
         return step
+
+    def _make_observables(self):
+        """Fused perturbation diagnostics ``(energy, ke, te, |div|)``:
+        the same plain grid-point sums :meth:`energy` uses (``energy`` ==
+        ``energy(0.5, 0.5)``), so growth-rate fits over the observable
+        trajectory and the optimization objective agree; |div| is the
+        NaN detector (observable_names index 3 by convention)."""
+        nav = self.navier
+        sp_t, sp_u, sp_v = nav.temp_space, nav.velx_space, nav.vely_space
+        scale = self.scale
+
+        def observables(state: NavierState):
+            u = sp_u.backward(state.velx)
+            v = sp_v.backward(state.vely)
+            t = sp_t.backward(state.temp)
+            ke = 0.5 * jnp.sum(u * u + v * v)
+            te = 0.5 * jnp.sum(t * t)
+            div = norm_l2(
+                sp_u.gradient(state.velx, (1, 0), scale)
+                + sp_v.gradient(state.vely, (0, 1), scale)
+            )
+            return 0.5 * (ke + te), ke, te, div
+
+        return observables
 
     # -- adjoint step ----------------------------------------------------------
 
@@ -359,81 +477,56 @@ class Navier2DLnse(Integrate):
 
     # -- compiled entry points -------------------------------------------------
 
+    # dt-baked artifacts (campaign rung cache) include the adjoint entries
+    _DT_ARTIFACTS = ("_adj_n", "_adj_consts") + CampaignModelBase._DT_ARTIFACTS
+
+    def _dt_changed(self, dt: float) -> None:
+        """Propagate a campaign dt change into the embedded Navier2D (whose
+        implicit solvers the linearized step shares) — its own rung cache
+        bounds the rebuild cost."""
+        self.navier.set_dt(dt)
+
     def _compile_entry_points(self) -> None:
-        nav = self.navier
-        example = jax.tree.map(
-            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), NavierState(*nav.state)
-        )
+        """The campaign entry points (hoisted ``_step_cc``/``_obs_cc``,
+        chunked scans, sentinels — CampaignModelBase) plus the lnse-specific
+        ADJOINT loop entries of the linearized model."""
+        super()._compile_entry_points()
+        if self.NONLINEAR:
+            return
         from ..utils.jit import hoist_constants
 
+        nav = self.navier
+        example = self._state_example()
+        adj = self._make_adjoint_step()
         with nav._scope():
-            step_cc, consts = hoist_constants(self._make_direct_step(), example)
-        self._consts = consts
-        step_jit = jax.jit(step_cc)
-        self._step = lambda s: step_jit(self._consts, s)
+            adj_cc, adj_consts = hoist_constants(lambda s: adj(s), example)
+        self._adj_consts = adj_consts
 
-        def step_n(consts, state, n: int):
+        def adj_n(consts, state, n: int):
             return jax.lax.scan(
-                lambda c, _: (step_cc(consts, c), None), state, None, length=n
+                lambda c, _: (adj_cc(consts, c), None), state, None, length=n
             )[0]
 
-        step_n_jit = jax.jit(step_n, static_argnames=("n",))
-        self._step_n = lambda s, n: step_n_jit(self._consts, s, n=n)
-
-        # adjoint (no history) for the linearized model
-        if not self.NONLINEAR:
-            adj = self._make_adjoint_step()
-            with nav._scope():
-                adj_cc, adj_consts = hoist_constants(lambda s: adj(s), example)
-            self._adj_consts = adj_consts
-
-            def adj_n(consts, state, n: int):
-                return jax.lax.scan(
-                    lambda c, _: (adj_cc(consts, c), None), state, None, length=n
-                )[0]
-
-            adj_n_jit = jax.jit(adj_n, static_argnames=("n",))
-            self._adj_n = lambda s, n: adj_n_jit(self._adj_consts, s, n=n)
+        adj_n_jit = jax.jit(adj_n, static_argnames=("n",))
+        self._adj_n = lambda s, n: adj_n_jit(self._adj_consts, s, n=n)
 
     # -- Integrate protocol ----------------------------------------------------
+    # update/update_n/update_n_pending, sentinels, set_dt, observable
+    # futures and exit/exit_future come from CampaignModelBase
 
-    def update(self) -> None:
-        with self.navier._scope():
-            self.state = self._step(self.state)
-        self.time += self.dt
-
-    update_direct = update
-
-    def update_n(self, n: int) -> None:
-        from ..utils.jit import run_scanned
-
-        with self.navier._scope():
-            self.state = run_scanned(self._step_n, self.state, n)
-        self.time += n * self.dt
-
-    def get_time(self) -> float:
-        return self.time
-
-    def get_dt(self) -> float:
-        return self.dt
-
-    def reset_time(self) -> None:
-        self.time = 0.0
+    def update_direct(self) -> None:
+        self.update()
 
     def _sync_navier(self) -> None:
         self.navier.state = NavierState(*self.state)
         self.navier.time = self.time
         self.navier._obs_cache = None
 
-    def get_observables(self):
+    def eval_nu(self) -> float:
+        """DNS-vocabulary Nu of the perturbation state (legacy IO paths);
+        the campaign observables are the perturbation energies."""
         self._sync_navier()
-        return self.navier.get_observables()
-
-    def div_norm(self) -> float:
-        return self.get_observables()[3]
-
-    def exit(self) -> bool:
-        return bool(np.isnan(self.div_norm()))
+        return self.navier.get_observables()[0]
 
     def callback(self) -> None:
         from ..utils import navier_io
@@ -448,11 +541,26 @@ class Navier2DLnse(Integrate):
     def init_random(self, amp: float, seed: int = 0) -> None:
         self.navier.init_random(amp, seed)
         self.state = NavierState(*self.navier.state)
+        self._obs_cache = None
+
+    def set_velocity(self, amp: float, m: float, n: float) -> None:
+        """Seed one velocity eigenmode shape (the eigenmode-sweep IC)."""
+        self._sync_navier()
+        self.navier.set_velocity(amp, m, n)
+        self.state = NavierState(*self.navier.state)
+        self._obs_cache = None
+
+    def set_temperature(self, amp: float, m: float, n: float) -> None:
+        self._sync_navier()
+        self.navier.set_temperature(amp, m, n)
+        self.state = NavierState(*self.navier.state)
+        self._obs_cache = None
 
     def set_field(self, name: str, values) -> None:
         self._sync_navier()
         self.navier.set_field(name, values)
         self.state = NavierState(*self.navier.state)
+        self._obs_cache = None
 
     def get_field(self, name: str):
         self._sync_navier()
@@ -463,9 +571,17 @@ class Navier2DLnse(Integrate):
         self.navier.write(filename)
 
     def read(self, filename: str) -> None:
+        from ..utils import checkpoint
+
+        if checkpoint.is_sharded_checkpoint(filename):
+            # topology-elastic manifest restore targets THIS model's
+            # snapshot surface (state/... names), not the embedded DNS's
+            checkpoint.read_sharded_snapshot(self, filename)
+            return
         self.navier.read(filename)
         self.state = NavierState(*self.navier.state)
         self.time = self.navier.time
+        self._obs_cache = None
 
     # -- energy / gradient machinery -------------------------------------------
 
@@ -571,7 +687,7 @@ class Navier2DLnse(Integrate):
     def _objective_fn(self, n: int, beta1, beta2, target: MeanFields | None):
         """J(u0, v0, T0 physical) = energy after n forward steps."""
         nav = self.navier
-        step = self._make_direct_step()
+        step = self._make_step()
         if target is not None:
             tu, tv, tt = target.physical()
 
@@ -678,7 +794,7 @@ class Navier2DNonLin(Navier2DLnse):
         )
         from ..utils.jit import hoist_constants
 
-        step = self._make_direct_step()
+        step = self._make_step()
         sp_u, sp_v, sp_t = nav.velx_space, nav.vely_space, nav.temp_space
 
         def fwd_with_history(state):

@@ -41,6 +41,7 @@ from ..solver import HholtzAdi, Poisson
 from ..utils.integrate import Integrate
 from . import boundary_conditions as bcs
 from . import functions as fns
+from .campaign import CampaignModelBase
 
 
 class NavierState(NamedTuple):
@@ -53,13 +54,99 @@ class NavierState(NamedTuple):
     pseu: jax.Array
 
 
-class Navier2D(Integrate):
+class NavierScalarState(NamedTuple):
+    """NavierState plus a passive scalar (the ``passive_scalar`` scenario
+    modifier): ``scal`` is advected by the flow and diffused at the scalar
+    diffusivity, with the temperature BC lift as its boundary forcing — so a
+    scalar released equal to the temperature with matched diffusivity stays
+    identically equal (the scenario's exact validation case)."""
+
+    temp: jax.Array
+    velx: jax.Array
+    vely: jax.Array
+    pres: jax.Array
+    pseu: jax.Array
+    scal: jax.Array
+
+
+def scenario_signature(scenario) -> tuple:
+    """Canonical compat-key signature of a scenario-modifier config (any
+    object carrying ``coriolis`` / ``passive_scalar`` / ``scalar_kappa``,
+    e.g. :class:`~rustpde_mpi_tpu.workloads.modifiers.ScenarioConfig`, or a
+    plain dict as carried by a :class:`~rustpde_mpi_tpu.serve.SimRequest`).
+    Modifier terms are baked into the compiled step, so they MUST flow
+    through ``compat_key`` — an empty/default scenario signs as ``()``,
+    equal to no scenario at all."""
+    if scenario is None:
+        return ()
+    get = (
+        scenario.get
+        if isinstance(scenario, dict)
+        else lambda k, d=None: getattr(scenario, k, d)
+    )
+    items = []
+    f = float(get("coriolis", 0.0) or 0.0)
+    if f:
+        items.append(("coriolis", f))
+    if get("passive_scalar", False):
+        kappa = get("scalar_kappa", None)
+        if kappa is not None and float(kappa) <= 0.0:
+            # 0.0 would collide with the thermal-default sentinel below
+            # (and a non-diffusive implicit solve is not supported)
+            raise ValueError(
+                f"scalar_kappa must be positive (got {kappa}); omit it for "
+                "the thermal diffusivity"
+            )
+        items.append(
+            ("passive_scalar", float(kappa) if kappa is not None else 0.0)
+        )
+    return tuple(items)
+
+
+def brinkman_factors(model, mask, value=None, eta: float | None = None):
+    """The pointwise implicit-Brinkman penalization factors
+    ``(fac, temp_add)`` for one obstacle on ``model``'s grid — THE single
+    implementation shared by :meth:`Navier2D.set_solid` (which bakes them
+    into the step) and the vmapped geometry sweep
+    (workloads/modifiers.py, which feeds them as per-member runtime
+    inputs); the sweep's bit-match-solo guarantee rests on this sharing.
+
+    ``fac = 1 / (1 + (dt/eta) mask)``; ``temp_add`` relaxes the
+    temperature toward ``value`` minus the BC lift (the temp state
+    excludes the lift field)."""
+    rdt = config.real_dtype()
+    mask = np.asarray(mask, dtype=np.float64)
+    if value is None:
+        value = np.zeros_like(mask)
+    if eta is None:
+        eta = model.dt / 10.0
+    a = (model.dt / float(eta)) * mask
+    fac = 1.0 / (1.0 + a)
+    # temp state excludes the BC lift field: target = value - tempbc
+    sp = model.field_space
+    with model._scope():
+        tempbc_phys = np.asarray(sp.backward_ortho(model.tempbc_ortho))
+    temp_add = a * (value - tempbc_phys) * fac
+    return jnp.asarray(fac, dtype=rdt), jnp.asarray(temp_add, dtype=rdt)
+
+
+class Navier2D(CampaignModelBase, Integrate):
     """2-D Rayleigh–Bénard convection solver.
 
     Construct via :meth:`new_confined` (Chebyshev x Chebyshev) or
     :meth:`new_periodic` (Fourier x Chebyshev); parameter vocabulary matches
     the reference (nx, ny, ra, pr, dt, aspect, bc in {"rbc", "hc"}).
-    """
+
+    The campaign-model machinery (chunked scans, sentinels, dt rung cache,
+    observable futures, snapshot surface — everything the ensemble engine,
+    governor, checkpoints and serve scheduler ride on) lives in
+    :class:`~rustpde_mpi_tpu.models.campaign.CampaignModelBase`; this class
+    supplies the physics: spaces, solvers, the step, the observables, and
+    the config-carried scenario modifiers (rotating-frame Coriolis term,
+    passive-scalar transport)."""
+
+    MODEL_KIND = "dns"
+    observable_names = ("nu", "nuvol", "re", "div")
 
     def __init__(
         self,
@@ -72,6 +159,7 @@ class Navier2D(Integrate):
         bc: str,
         periodic: bool,
         mesh=None,
+        scenario=None,
     ):
         if bc not in ("rbc", "hc"):
             raise ValueError(f"boundary condition type {bc!r} not recognized")
@@ -81,7 +169,6 @@ class Navier2D(Integrate):
         self.mesh = mesh
         self.nx, self.ny = nx, ny
         self.dt = dt
-        self.time = 0.0
         self.periodic = periodic
         self.bc = bc
         self.scale = (float(aspect), 1.0)
@@ -90,18 +177,12 @@ class Navier2D(Integrate):
         self.params = {"ra": ra, "pr": pr, "nu": nu, "ka": ka}
         self.write_intervall: float | None = None
         self.statistics = None
-        self._obs_cache: tuple | None = None
+        self._init_campaign()  # obs cache, sentinels, dt rung cache
         self._solid = None  # (penalization factors) set via set_solid()
-        # stability sentinels (utils/governor.py): None = plain stepping;
-        # set_stability compiles the sentinel step variant into update_n
-        self._stability = None
-        self.last_chunk_status = None
-        self._pre_div_latch = False
-        # per-rung cache of dt-baked artifacts (solvers + compiled entry
-        # points), so a governor cycling a bounded dt ladder re-jits each
-        # rung at most once; recompile_count tracks actual rebuilds
-        self._dt_cache: dict[float, dict] = {}
-        self.recompile_count = 0
+        # config-carried scenario step modifiers (rotating-frame Coriolis,
+        # passive scalar — see workloads/modifiers.ScenarioConfig); baked
+        # into the compiled step, signed into compat_key
+        self._scenario = scenario
         # diagnostics history appended by the IO callback — the map the
         # reference allocates but never writes (navier.rs:81)
         self.diagnostics: dict[str, list[float]] = {}
@@ -151,6 +232,10 @@ class Navier2D(Integrate):
         self.solver_vely = self.solver_velx  # identical operator, shared factors
         self.solver_temp = HholtzAdi(self.temp_space, (dt * ka / sx2, dt * ka / sy2))
         self.solver_pres = Poisson(self.pseu_space, (1.0 / sx2, 1.0 / sy2))
+        # passive-scalar solver (scenario modifier): the scalar shares the
+        # temperature's composite space and BC lift; at matched diffusivity
+        # it shares the temperature solver's factorizations outright
+        self.solver_scal = self._build_scalar_solver()
 
         # dealiasing mask over the scratch spectral shape (split-aware)
         self._dealias = jnp.asarray(self.field_space.dealias_mask(), dtype=rdt)
@@ -179,25 +264,153 @@ class Navier2D(Integrate):
         self._compile_entry_points()
 
         with self._scope():
-            self.state = NavierState(
-                temp=self._place(self.temp_space.ndarray_spectral()),
-                velx=self._place(self.velx_space.ndarray_spectral()),
-                vely=self._place(self.vely_space.ndarray_spectral()),
-                pres=self._place(self.pres_space.ndarray_spectral()),
-                pseu=self._place(self.pseu_space.ndarray_spectral()),
+            self.state = self._state_cls()(
+                **{
+                    name: self._place(space.ndarray_spectral())
+                    for name, space in self._state_fields()
+                }
             )
 
     # one-time-warning latch for the GSPMD split-sep fallback (class-level:
     # one warning per process, not per model)
     _warned_split_sep_fallback = False
 
-    # overlapped-IO hooks (utils/io_pipeline.py): an attached IOPipeline
-    # routes callback IO (flow snapshots, diagnostics lines) through the
-    # background writer / lag queue, and io_overlap opts the chunked driver
-    # into lagged break checks (utils/integrate.py).  Class-level defaults
-    # keep plain models fully synchronous.
-    io_pipeline = None
-    io_overlap = False
+    # -- scenario modifiers ---------------------------------------------------
+
+    def _scn(self, key, default=None):
+        """Scenario attribute lookup (dataclass or request-carried dict)."""
+        scn = self._scenario
+        if scn is None:
+            return default
+        if isinstance(scn, dict):
+            return scn.get(key, default)
+        return getattr(scn, key, default)
+
+    def _coriolis(self) -> float:
+        return float(self._scn("coriolis", 0.0) or 0.0)
+
+    def _scalar_active(self) -> bool:
+        return bool(self._scn("passive_scalar", False))
+
+    def _scalar_kappa(self) -> float:
+        """Scalar diffusivity (``None`` defaults to the thermal one — the
+        matched-diffusivity configuration whose scalar mirrors the
+        temperature; non-positive values are rejected, see
+        :func:`scenario_signature`)."""
+        kappa = self._scn("scalar_kappa", None)
+        if kappa is None:
+            return float(self.params["ka"])
+        kappa = float(kappa)
+        if kappa <= 0.0:
+            raise ValueError(f"scalar_kappa must be positive, got {kappa}")
+        return kappa
+
+    def _build_scalar_solver(self):
+        if not self._scalar_active():
+            return None
+        kc = self._scalar_kappa()
+        if kc == float(self.params["ka"]):
+            return self.solver_temp  # identical operator, shared factors
+        sx2, sy2 = self.scale[0] ** 2, self.scale[1] ** 2
+        return HholtzAdi(self.temp_space, (self.dt * kc / sx2, self.dt * kc / sy2))
+
+    def _scan_ok(self, state):
+        """The in-scan divergence detector.  A NaN in the FLOW infects temp
+        within one step (buoyancy/convection), but the passive scalar is
+        one-way coupled — a scal-only NaN would never reach temp — so the
+        scalar leaf joins the finiteness probe when the scenario carries
+        one (one extra reduction, scalar models only)."""
+        probe = jnp.sum(state.temp)
+        if self._scalar_active():
+            probe = probe + jnp.sum(state.scal)
+        return jnp.isfinite(probe)
+
+    @property
+    def scal_space(self):
+        """The passive scalar rides the temperature's composite space."""
+        return self.temp_space
+
+    @property
+    def scenario(self):
+        return self._scenario
+
+    def set_scenario(self, scenario) -> None:
+        """Install (or clear, ``None``) the scenario step modifiers on a
+        live model: the modifier terms are operator constants, so the entry
+        points recompile and every dt rung is invalidated.  Toggling the
+        passive scalar restructures the state pytree (the ``scal`` leaf is
+        added zero-initialized / dropped); all other leaves are kept."""
+        self._scenario = scenario
+        self._dt_cache.clear()
+        self.solver_scal = self._build_scalar_solver()
+        want_scal = self._scalar_active()
+        have_scal = hasattr(self.state, "scal")
+        if want_scal and not have_scal:
+            with self._scope():
+                self.state = NavierScalarState(
+                    *self.state,
+                    scal=self._place(self.temp_space.ndarray_spectral()),
+                )
+        elif not want_scal and have_scal:
+            self.state = NavierState(*self.state[:5])
+        self._compile_entry_points()
+        self._obs_cache = None
+
+    def _state_fields(self) -> list:
+        """Ordered ``(leaf_name, space)`` of the state pytree (the scenario
+        decides whether the scalar leaf exists)."""
+        fields = [
+            ("temp", self.temp_space),
+            ("velx", self.velx_space),
+            ("vely", self.vely_space),
+            ("pres", self.pres_space),
+            ("pseu", self.pseu_space),
+        ]
+        if self._scalar_active():
+            fields.append(("scal", self.temp_space))
+        return fields
+
+    def _state_cls(self):
+        return NavierScalarState if self._scalar_active() else NavierState
+
+    def _state_example(self):
+        return self._state_cls()(
+            **{
+                name: jax.ShapeDtypeStruct(
+                    space.shape_spectral, space.spectral_dtype()
+                )
+                for name, space in self._state_fields()
+            }
+        )
+
+    @property
+    def snapshot_vars(self) -> tuple:
+        """``(h5 var name, state attr)`` rows the gathered snapshot format
+        carries — the checkpoint layer consults this so scenario-extended
+        states round-trip (utils/checkpoint)."""
+        base = (("ux", "velx"), ("uy", "vely"), ("temp", "temp"), ("pres", "pres"))
+        if self._scalar_active():
+            return base + (("scal", "scal"),)
+        return base
+
+    def _compile_eager_entry_points(self) -> None:
+        """The campaign base's per-stage eager fallback, plus the one-time
+        (per-process) warning naming the GSPMD miscompile it routes around."""
+        if not Navier2D._warned_split_sep_fallback:
+            import warnings
+
+            warnings.warn(
+                "the fused split-sep periodic step is miscompiled by "
+                "GSPMD under an active mesh (xfailed in "
+                "tests/test_parallel.py); falling back to per-stage "
+                "eager execution — multichip periodic runs are slower "
+                "but correct.  Set RUSTPDE_FORCE_FUSED_GSPMD=1 to force "
+                "the fused path.",
+                RuntimeWarning,
+                stacklevel=2,
+            )
+            Navier2D._warned_split_sep_fallback = True
+        super()._compile_eager_entry_points()
 
     def _gspmd_split_sep_fallback(self) -> bool:
         """True when the FUSED jitted step would be miscompiled: GSPMD
@@ -218,195 +431,11 @@ class Navier2D(Integrate):
         sp = self.temp_space
         return sp.bases[0].kind.is_split and any(sp.sep)
 
-    def _compile_entry_points(self) -> None:
-        example = NavierState(
-            temp=jax.ShapeDtypeStruct(
-                self.temp_space.shape_spectral, self.temp_space.spectral_dtype()
-            ),
-            velx=jax.ShapeDtypeStruct(
-                self.velx_space.shape_spectral, self.velx_space.spectral_dtype()
-            ),
-            vely=jax.ShapeDtypeStruct(
-                self.vely_space.shape_spectral, self.vely_space.spectral_dtype()
-            ),
-            pres=jax.ShapeDtypeStruct(
-                self.pres_space.shape_spectral, self.pres_space.spectral_dtype()
-            ),
-            pseu=jax.ShapeDtypeStruct(
-                self.pseu_space.shape_spectral, self.pseu_space.spectral_dtype()
-            ),
-        )
-        from ..utils.jit import hoist_constants
-
-        self.recompile_count += 1
-        self._sent_cc = None
-        self._sent_consts = None
-        self._step_n_sent = None
-        with self._scope():
-            step_cc, step_consts = hoist_constants(self._make_step(), example)
-            obs_cc, obs_consts = hoist_constants(self._make_observables(), example)
-        self._step_consts = step_consts
-        self._obs_consts = obs_consts
-        # retained for the ensemble engine (models/ensemble.py): the SAME
-        # traced jaxpr is vmapped over a leading member axis there — one
-        # physics code path, batch as a leading axis, no forked step
-        self._step_cc = step_cc
-        self._obs_cc = obs_cc
-
-        if self._gspmd_split_sep_fallback():
-            if not Navier2D._warned_split_sep_fallback:
-                import warnings
-
-                warnings.warn(
-                    "the fused split-sep periodic step is miscompiled by "
-                    "GSPMD under an active mesh (xfailed in "
-                    "tests/test_parallel.py); falling back to per-stage "
-                    "eager execution — multichip periodic runs are slower "
-                    "but correct.  Set RUSTPDE_FORCE_FUSED_GSPMD=1 to force "
-                    "the fused path.",
-                    RuntimeWarning,
-                    stacklevel=2,
-                )
-                Navier2D._warned_split_sep_fallback = True
-            step_fn = self._make_step()
-            obs_fn = self._make_observables()
-            self._step = step_fn
-
-            def step_n_eager(state, n):
-                # same semantics as the scanned fast path: the state that
-                # first went non-finite is kept, later steps are identity
-                done = 0
-                for _ in range(int(n)):
-                    state = step_fn(state)
-                    done += 1
-                    if not bool(jnp.isfinite(jnp.sum(state.temp))):
-                        break
-                return state, jnp.asarray(done, jnp.int32)
-
-            self._step_n = step_n_eager
-            self._obs_fn = obs_fn
-            return
-
-        step_jit = jax.jit(step_cc)
-        self._step = lambda s: step_jit(self._step_consts, s)
-
-        def step_n(consts, state, n: int):
-            """n scanned steps with in-chunk divergence early-exit: an
-            is-finite flag rides the carry, and once the flow is NaN the
-            remaining iterations take the identity branch of a ``lax.cond``
-            — the device stops paying for GEMMs mid-chunk instead of burning
-            the rest of a minutes-long chunk on NaNs (the reference checks
-            ``pde.exit()`` every step, /root/reference/src/lib.rs:187-219).
-            Returns ``(state, steps_done)``; a NaN temp field infects velx
-            within one step (buoyancy) and vice versa (convection), so one
-            reduction over temp per step is a complete detector."""
-
-            def advance(carry):
-                st, _, done = carry
-                st2 = step_cc(consts, st)
-                ok2 = jnp.isfinite(jnp.sum(st2.temp))
-                return st2, ok2, done + 1
-
-            def body(carry, _):
-                carry2 = jax.lax.cond(carry[1], advance, lambda c: c, carry)
-                return carry2, None
-
-            init = (state, jnp.asarray(True), jnp.asarray(0, jnp.int32))
-            (final, _, done), _ = jax.lax.scan(body, init, None, length=n)
-            return final, done
-
-        # donate the state: XLA aliases the five input coefficient buffers to
-        # the scan carry's outputs, so a chunked dispatch updates the state
-        # in place instead of holding a second resident copy in HBM.  Callers
-        # must hand in buffers they no longer need — update_n dispatches a
-        # fresh copy first, keeping references retained to ``self.state``
-        # across the call valid (no use-after-donate on the public API).
-        step_n_jit = jax.jit(
-            step_n, static_argnames=("n",), donate_argnums=(1,)
-        )
-        self._step_n = lambda s, n: step_n_jit(self._step_consts, s, n=n)
-        obs_jit = jax.jit(obs_cc)
-        self._obs_fn = lambda s: obs_jit(self._obs_consts, s)
-
-        if self._stability is not None:
-            self._compile_sentinel_entry_points(example)
-
-    def _compile_sentinel_entry_points(self, example) -> None:
-        """Sentinel variant of the scanned chunk (set_stability): the carry
-        additionally holds a CFL-ok flag and running sentinel reductions, and
-        the early-exit fires on EITHER a non-finite state (the NaN path, as
-        before) or a per-step CFL above ``max_cfl`` — the *pre-divergence*
-        catch, taken while the state is still finite so the chunk can be
-        recovered by an in-memory rollback instead of a checkpoint restore.
-        One small scalar fetch per chunk; the buckets themselves stay
-        asynchronous and donate their carry like the plain path."""
-        from ..utils.jit import hoist_constants
-
-        with self._scope():
-            sent_cc, sent_consts = hoist_constants(
-                self._make_step(with_sentinels=True), example
-            )
-        self._sent_cc = sent_cc
-        self._sent_consts = sent_consts
-        ceiling = float(self._stability.max_cfl)
-
-        def step_n_sent(consts, carry, n: int):
-            def advance(carry):
-                st, fin, cok, done, cflm, gm, dvm, kep = carry
-                st2, (cfl, ke, dv) = sent_cc(consts, st)
-                fin2 = jnp.isfinite(jnp.sum(st2.temp))
-                # NaN cfl must read as the NaN path, not a ceiling trip:
-                # NaN > ceiling is False, so ~(cfl > ceiling) stays True
-                cok2 = jnp.logical_not(cfl > ceiling)
-                growth = jnp.where(kep > 0.0, ke / kep, 1.0)
-                return (
-                    st2,
-                    fin2,
-                    cok2,
-                    done + 1,
-                    jnp.maximum(cflm, cfl),
-                    jnp.maximum(gm, growth),
-                    jnp.maximum(dvm, dv),
-                    ke,
-                )
-
-            def body(carry, _):
-                carry2 = jax.lax.cond(
-                    carry[1] & carry[2], advance, lambda c: c, carry
-                )
-                return carry2, None
-
-            final, _ = jax.lax.scan(body, carry, None, length=n)
-            return final
-
-        sent_jit = jax.jit(
-            step_n_sent, static_argnames=("n",), donate_argnums=(1,)
-        )
-        self._step_n_sent = lambda c, n: sent_jit(self._sent_consts, c, n=n)
-
-    # -- sharding helpers ----------------------------------------------------
-
-    def _scope(self):
-        """Activate this model's mesh for the duration of a trace/dispatch."""
-        from ..parallel.mesh import use_mesh
-
-        if self.mesh is None:
-            import contextlib
-
-            return contextlib.nullcontext()
-        return use_mesh(self.mesh)
-
-    def _place(self, arr):
-        """Put a spectral array into x-pencil layout under the mesh."""
-        from ..parallel.mesh import SPEC, device_put
-
-        return device_put(arr, SPEC)
-
-    @property
-    def compat_key(self) -> tuple:
-        """Everything baked into the model's operator constants — grid,
-        physics parameters, dt (the implicit solvers factorize ``dt*nu``),
-        geometry and BC family.  Two requests with equal keys can share one
+    def _compat_fields(self) -> tuple:
+        """Everything (beyond the kind prefix) baked into the model's
+        operator constants — grid, physics parameters, dt (the implicit
+        solvers factorize ``dt*nu``), geometry, BC family, and the scenario
+        modifier signature.  Two requests with equal keys can share one
         compiled step jaxpr (and therefore one ensemble batch: the serve
         scheduler buckets by this key); anything differing forces a fresh
         model build + compile."""
@@ -419,6 +448,7 @@ class Navier2D(Integrate):
             float(self.scale[0]),
             str(self.bc),
             bool(self.periodic),
+            scenario_signature(self._scenario),
         )
 
     # -- construction --------------------------------------------------------
@@ -442,7 +472,12 @@ class Navier2D(Integrate):
     @classmethod
     def from_config(cls, cfg, mesh=None) -> "Navier2D":
         """Construct from a :class:`~rustpde_mpi_tpu.config.NavierConfig`."""
-        model = cls(*cfg.ctor_args(), periodic=cfg.periodic, mesh=mesh)
+        model = cls(
+            *cfg.ctor_args(),
+            periodic=cfg.periodic,
+            mesh=mesh,
+            scenario=getattr(cfg, "scenario", None),
+        )
         if cfg.init_random_amp:
             model.init_random(cfg.init_random_amp)
         model.write_intervall = cfg.write_intervall
@@ -493,8 +528,12 @@ class Navier2D(Integrate):
             temp <- (temp + dt/eta * mask * value) / (1 + dt/eta * mask)
 
         which is unconditionally stable for any eta.  Pass ``mask=None`` to
-        remove the obstacle."""
-        rdt = config.real_dtype()
+        remove the obstacle.
+
+        The factor math lives in :func:`brinkman_factors` — shared verbatim
+        with the vmapped geometry sweep
+        (workloads/modifiers.geometry_sweep), whose bit-match-solo guarantee
+        depends on the two paths never diverging."""
         # cached per-dt artifacts embed the penalization factors of the OLD
         # obstacle — changing the obstacle invalidates every rung
         self._dt_cache.clear()
@@ -507,19 +546,13 @@ class Navier2D(Integrate):
             value = np.zeros_like(mask)
         if eta is None:
             eta = self.dt / 10.0
-        a = (self.dt / eta) * mask
-        fac = 1.0 / (1.0 + a)
-        # temp state excludes the BC lift field: target = value - tempbc
-        sp = self.field_space
-        with self._scope():
-            tempbc_phys = np.asarray(sp.backward_ortho(self.tempbc_ortho))
-        temp_add = a * (value - tempbc_phys) * fac
+        fac, temp_add = brinkman_factors(self, mask, value, eta)
         self._solid = {
             "mask": mask,
             "value": value,
             "eta": float(eta),  # retained so set_dt can rebuild the factors
-            "fac": jnp.asarray(fac, dtype=rdt),
-            "temp_add": jnp.asarray(temp_add, dtype=rdt),
+            "fac": fac,
+            "temp_add": temp_add,
         }
         self._compile_entry_points()
 
@@ -603,6 +636,12 @@ class Navier2D(Integrate):
         )
         solid = self._solid
         proj_grad = self._proj_grad
+        # scenario step modifiers (operator constants — signed into
+        # compat_key): rotating-frame Coriolis rate + passive scalar
+        coriolis = self._coriolis()
+        has_scal = self._scalar_active()
+        sol_c = self.solver_scal
+        kc_over_ka = (self._scalar_kappa() / self.params["ka"]) if has_scal else 1.0
 
         # RUSTPDE_SOLVE_PRECISION: experiment knob (default OFF) scoping a
         # matmul-precision override to the four implicit solves ONLY — the
@@ -667,7 +706,9 @@ class Navier2D(Integrate):
             def pin(a):
                 return constrain(a, SPEC)
 
-            temp, velx, vely, pres, pseu = state
+            temp, velx, vely, pres, pseu = (
+                state.temp, state.velx, state.vely, state.pres, state.pseu
+            )
             # buoyancy (full ortho space, includes the lift field)
             that = sp_t.to_ortho(temp) + tb_ortho
             # convection velocity in physical space (old time level; fast
@@ -687,6 +728,13 @@ class Navier2D(Integrate):
             rhs = sp_u.to_ortho(velx)
             rhs = rhs - dt * sp_p.gradient(pres, (1, 0), scale)
             rhs = rhs - dt * conv(ux, uy, sp_u, velx)
+            if coriolis:
+                # rotating-frame f-plane term +f*v (velx/vely share one
+                # space, so the cross-coupling is a plain ortho-space add);
+                # in exactly incompressible 2-D flow this force is
+                # irrotational and absorbed by the pressure — the scenario's
+                # analytic validation case (tests/test_workloads.py)
+                rhs = rhs + dt * coriolis * sp_v.to_ortho(vely)
             with solve_scope():
                 velx_n = sol_u.solve(pin(rhs))
 
@@ -695,6 +743,8 @@ class Navier2D(Integrate):
             rhs = rhs - dt * sp_p.gradient(pres, (0, 1), scale)
             rhs = rhs + dt * that
             rhs = rhs - dt * conv(ux, uy, sp_v, vely)
+            if coriolis:
+                rhs = rhs - dt * coriolis * sp_u.to_ortho(velx)
             with solve_scope():
                 vely_n = sol_v.solve(pin(rhs))
 
@@ -722,6 +772,19 @@ class Navier2D(Integrate):
             with solve_scope():
                 temp_n = sol_t.solve(pin(rhs))
 
+            if has_scal:
+                # passive scalar (scenario modifier): the temperature's
+                # advection-diffusion at the scalar diffusivity, same BC
+                # lift — with matched diffusivity a scalar released equal
+                # to the temperature stays identically equal (exact
+                # validation case); the buoyancy never reads it (one-way
+                # coupling, hence "passive")
+                rhs = sp_t.to_ortho(state.scal)
+                rhs = rhs + kc_over_ka * tb_diff  # dt*kc*lap(bc lift)
+                rhs = rhs - dt * conv(ux, uy, sp_t, state.scal, with_bc=True)
+                with solve_scope():
+                    scal_n = sol_c.solve(pin(rhs))
+
             if solid is not None:
                 # implicit pointwise Brinkman penalization (set_solid):
                 # elementwise in physical space, exact for the sub-step
@@ -729,14 +792,26 @@ class Navier2D(Integrate):
                 velx_n = sp_u.forward(sp_u.backward(velx_n) * fac)
                 vely_n = sp_v.forward(sp_v.backward(vely_n) * fac)
                 temp_n = sp_t.forward(sp_t.backward(temp_n) * fac + temp_add)
+                if has_scal:
+                    # the solid enforces the same target on the scalar
+                    scal_n = sp_t.forward(
+                        sp_t.backward(scal_n) * fac + temp_add
+                    )
 
             # pin the step outputs too: the next step's transforms assume the
             # x-pencil layout, and XLA's sharding propagation is free to emit
             # replicated outputs otherwise — which silently serializes a
             # multi-chip run
-            state_n = NavierState(
-                pin(temp_n), pin(velx_n), pin(vely_n), pin(pres_n), pin(pseu_n)
-            )
+            if has_scal:
+                state_n = NavierScalarState(
+                    pin(temp_n), pin(velx_n), pin(vely_n), pin(pres_n),
+                    pin(pseu_n), pin(scal_n),
+                )
+            else:
+                state_n = NavierState(
+                    pin(temp_n), pin(velx_n), pin(vely_n), pin(pres_n),
+                    pin(pseu_n),
+                )
             if with_sentinels:
                 # |div| of the uncorrected velocities — the residual the
                 # projection removes this step; its blow-up tracks the flow's
@@ -768,6 +843,7 @@ class Navier2D(Integrate):
         tb = self.tempbc_ortho
         w0, w1 = self._w0, self._w1
         div_fn = self._make_div()
+        scalar_active = self._scalar_active()
 
         def avg_x(v):
             return jnp.sum(v * w0[:, None], axis=0)
@@ -797,156 +873,20 @@ class Navier2D(Integrate):
             re = avg(jnp.sqrt(ux**2 + uy**2) * 2.0 * scale[1] / nu)
             # divergence norm
             dnorm = norm_l2(div_fn(state))
+            if scalar_active:
+                # fold the scalar's finiteness into the NaN-detector
+                # observable (a scal-only NaN is invisible to the flow —
+                # exit()/state_healthy/serve isolation all watch dnorm)
+                dnorm = dnorm + 0.0 * jnp.sum(jnp.abs(state.scal))
             return nu_plate, nu_vol, re, dnorm
 
         return observables
 
-    # -- Integrate protocol --------------------------------------------------
-
-    def update(self) -> None:
-        with self._scope():
-            self.state = self._step(self.state)
-        self.time += self.dt
-
-    def update_n(self, n: int):
-        """Advance n steps on the device via scanned power-of-two chunks
-        (utils/jit.run_scanned).  Dispatches stay asynchronous (no per-bucket
-        host sync — through the relay a sync costs ~110 ms) and donate their
-        input state buffers (see _compile_entry_points); on divergence the
-        in-scan early exit freezes the state, ``exit()`` reports it at the
-        next chunk boundary, and ``self.time`` deliberately counts the
-        scheduled steps (the post-NaN run is over either way).
-
-        With stability sentinels armed (:meth:`set_stability`) the chunk
-        additionally returns a :class:`~rustpde_mpi_tpu.utils.governor.ChunkStatus`
-        (also stored as ``self.last_chunk_status``): a per-step CFL above the
-        hard ceiling early-exits the scan with ``pre_divergence`` while the
-        state is still finite, the chunk is rolled back in memory (state and
-        time untouched — the chunk-start snapshot is exactly the un-donated
-        ``self.state``) and ``exit()`` latches True until a governor
-        acknowledges via :meth:`clear_pre_divergence`."""
-        from ..utils.jit import run_scanned
-
-        if self._step_n_sent is not None:
-            return self._update_n_sentinel(n)
-        with self._scope():
-            # the chunked dispatch donates its input buffers; hand it a copy
-            # so a state reference the caller retained stays readable, while
-            # every inter-bucket hand-off inside the chain is donated
-            state = jax.tree.map(jnp.copy, self.state)
-            self.state = run_scanned(
-                lambda s, k: self._step_n(s, k)[0], state, n
-            )
-        self.time += n * self.dt
-        return None
-
-    def _update_n_sentinel(self, n: int):
-        """Sentinel-armed chunk: scan with CFL/KE/|div| reductions riding the
-        carry, one scalar fetch at the end (the only extra host sync)."""
-        return self.update_n_pending(n).resolve()
-
-    def update_n_pending(self, n: int):
-        """Sentinel-armed chunk with a DEFERRED commit decision (the lag=1
-        contract of the overlapped driver, utils/io_pipeline.py): dispatch
-        the scanned chunk, PROVISIONALLY advance ``state``/``time`` to its
-        end, and return a
-        :class:`~rustpde_mpi_tpu.utils.io_pipeline.PendingChunkStatus` whose
-        ``resolve()`` fetches the sentinel scalars and either confirms the
-        advance or restores the chunk-start snapshot (+ latches ``exit()``)
-        — exactly the synchronous :meth:`update_n` outcome, decided one
-        host round-trip later.  The governed runner dispatches chunk i+1
-        from the provisional state before resolving chunk i, so the device
-        queue never drains while the governor reads the sentinels; the
-        on-device CFL ceiling guards the speculative chunk (it steps a
-        frozen, finite state when chunk i tripped)."""
-        from ..utils.governor import ChunkStatus
-        from ..utils.io_pipeline import PendingChunkStatus
-        from ..utils.jit import run_scanned
-
-        if self._step_n_sent is None:
-            raise RuntimeError(
-                "update_n_pending requires armed stability sentinels "
-                "(set_stability)"
-            )
-        self._pre_div_latch = False
-        rdt = config.real_dtype()
-        with self._scope():
-            state = jax.tree.map(jnp.copy, self.state)
-            carry = (
-                state,
-                jnp.asarray(True),
-                jnp.asarray(True),
-                jnp.asarray(0, jnp.int32),
-                jnp.asarray(0.0, rdt),  # cfl max
-                jnp.asarray(0.0, rdt),  # ke growth max
-                jnp.asarray(0.0, rdt),  # |div| max
-                jnp.asarray(0.0, rdt),  # previous-step ke
-            )
-            carry = run_scanned(lambda c, k: self._step_n_sent(c, k), carry, n)
-        st, fin, cok, done, cflm, gm, dvm, ke = carry
-        snapshot = (self.state, self.time)
-        self.state = st  # provisional: resolve() confirms or restores
-        self.time += n * self.dt
-        dt = self.dt
-
-        def finish(fetched):
-            fin_h, cok_h, done_h, cflm_h, gm_h, dvm_h, ke_h = fetched
-            fin_b, cok_b = bool(fin_h), bool(cok_h)
-            pre_div = fin_b and not cok_b
-            if pre_div:
-                # in-memory rollback: the dispatch stepped a donated COPY,
-                # so the snapshot still holds the chunk-start state — put it
-                # back and latch exit() until a governor acts
-                self.state, self.time = snapshot
-                self._pre_div_latch = True
-            status = ChunkStatus(
-                requested=int(n),
-                steps_done=int(done_h),
-                finite=fin_b,
-                cfl_ok=cok_b,
-                pre_divergence=pre_div,
-                cfl_max=float(cflm_h),
-                ke=float(ke_h),
-                ke_growth_max=float(gm_h),
-                div_max=float(dvm_h),
-                dt=dt,
-            )
-            self.last_chunk_status = status
-            return status
-
-        return PendingChunkStatus((fin, cok, done, cflm, gm, dvm, ke), finish)
-
-    def set_stability(self, cfg) -> None:
-        """Arm/disarm (``None``) the on-device stability sentinels
-        (:class:`~rustpde_mpi_tpu.config.StabilityConfig`): compiles the
-        sentinel variant of the scanned chunk into :meth:`update_n`.  Under
-        the GSPMD split-sep fallback the sentinel path is unavailable and
-        stepping stays plain (a one-time warning is emitted)."""
-        self._stability = cfg
-        self._dt_cache.clear()  # cached artifacts lack/stale sentinel entries
-        self._compile_entry_points()
-        if cfg is not None and self._step_n_sent is None:
-            import warnings
-
-            warnings.warn(
-                "stability sentinels are not available on the per-stage "
-                "eager GSPMD fallback path; stepping stays plain",
-                RuntimeWarning,
-                stacklevel=2,
-            )
-        self.last_chunk_status = None
-        self._pre_div_latch = False
-
-    def clear_pre_divergence(self) -> None:
-        """Acknowledge a ``pre_divergence`` catch (the governor changed dt /
-        killed members and wants the chunk retried): unlatch ``exit()``."""
-        self._pre_div_latch = False
-
-    def get_time(self) -> float:
-        return self.time
-
-    def get_dt(self) -> float:
-        return self.dt
+    # -- Integrate protocol / campaign machinery ------------------------------
+    # update/update_n/update_n_pending, sentinels, set_stability, the dt rung
+    # cache, observable futures and exit/exit_future live in
+    # models/campaign.CampaignModelBase — this class only lists what a dt
+    # change invalidates and how to rebuild it.
 
     # attributes a dt change swaps out, cached per rung so a governor
     # cycling a bounded dt ladder refactorizes/re-jits each rung ONCE
@@ -956,60 +896,28 @@ class Navier2D(Integrate):
         "solver_velx",
         "solver_vely",
         "solver_temp",
+        "solver_scal",
         "tempbc_ortho",
         "_tempbc_dx",
         "_tempbc_dy",
         "_tempbc_diff",
         "_solid",
-        "_step",
-        "_step_n",
-        "_obs_fn",
-        "_step_cc",
-        "_obs_cc",
-        "_step_consts",
-        "_obs_consts",
-        "_sent_cc",
-        "_sent_consts",
-        "_step_n_sent",
-    )
+    ) + CampaignModelBase._DT_ARTIFACTS
 
-    def _dt_artifacts(self) -> dict:
-        return {k: getattr(self, k, None) for k in self._DT_ARTIFACTS}
-
-    def set_dt(self, dt: float) -> None:
-        """Change the time-step size of a live model (the governor's dt
-        ladder and the divergence-retry backoff, utils/resilience.py +
-        utils/governor.py).
-
-        dt is baked deep into the pipeline — the implicit Helmholtz solvers
-        factorize ``dt*nu`` / ``dt*ka``, the BC diffusion source scales with
-        dt, and a solid mask's penalization factors use dt/eta — so a FIRST
-        visit to a dt rebuilds solvers + lift-field derivatives and
-        re-traces the jitted entry points.  Every artifact is then cached
-        per dt value, so revisiting a rung (the governor climbing back up
-        its ladder) swaps the cached objects back in — the retained jit
-        closures keep their identity, so XLA's executable cache hits and the
-        total re-jit count over a long governed run is bounded by the ladder
-        size.  State and time are untouched either way: the flow continues
-        from the same fields at the new step size."""
-        dt = float(dt)
-        if dt <= 0.0:
-            raise ValueError(f"dt must be positive, got {dt}")
-        if dt == self.dt:
-            return
-        self._dt_cache[self.dt] = self._dt_artifacts()
-        self.dt = dt
-        cached = self._dt_cache.get(dt)
-        if cached is not None:
-            for key, value in cached.items():
-                setattr(self, key, value)
-            self._obs_cache = None
-            return
+    def _rebuild_dt_artifacts(self) -> None:
+        """First visit to a dt rung: dt is baked deep into the pipeline —
+        the implicit Helmholtz solvers factorize ``dt*nu`` / ``dt*ka``, the
+        BC diffusion source scales with dt, and a solid mask's penalization
+        factors use dt/eta — so rebuild solvers + lift-field derivatives and
+        re-trace the jitted entry points (see CampaignModelBase.set_dt for
+        the rung-cache contract)."""
+        dt = self.dt
         nu, ka = self.params["nu"], self.params["ka"]
         sx2, sy2 = self.scale[0] ** 2, self.scale[1] ** 2
         self.solver_velx = HholtzAdi(self.velx_space, (dt * nu / sx2, dt * nu / sy2))
         self.solver_vely = self.solver_velx
         self.solver_temp = HholtzAdi(self.temp_space, (dt * ka / sx2, dt * ka / sy2))
+        self.solver_scal = self._build_scalar_solver()
         # solver_pres is dt-independent (pure Poisson)
         xs, ys = (b.points for b in self.field_space.bases)
         with self._scope():
@@ -1027,33 +935,6 @@ class Navier2D(Integrate):
                 self._dt_cache = cache
         else:
             self._compile_entry_points()
-        self._obs_cache = None
-
-    def get_observables_async(self):
-        """Dispatch the fused ``(Nu, Nuvol, Re, |div|)`` computation and
-        return an :class:`~rustpde_mpi_tpu.utils.io_pipeline.ObservableFuture`
-        WITHOUT waiting for it — the device keeps working while the host
-        decides when (if ever) to fetch.  Cached per state, shared with the
-        synchronous accessors and :meth:`exit_future`, so diagnostics + break
-        checks cost ONE dispatch and ONE host transfer per state."""
-        from ..utils.io_pipeline import ObservableFuture
-
-        if self._obs_cache is None or self._obs_cache[0] is not self.state:
-            with self._scope():
-                fut = ObservableFuture(
-                    self._obs_fn(self.state),
-                    convert=lambda vals: tuple(float(v) for v in vals),
-                )
-            self._obs_cache = (self.state, fut)
-        return self._obs_cache[1]
-
-    def get_observables(self) -> tuple[float, float, float, float]:
-        """(Nu, Nuvol, Re, |div|) — one fused device dispatch, cached per
-        state so callback printing + exit checks don't recompute.  The four
-        scalars arrive in ONE host transfer (the future's ``device_get``),
-        not four sequential blocking conversions — through the TPU relay
-        each round-trip costs ~110 ms."""
-        return self.get_observables_async().result()
 
     def eval_nu(self) -> float:
         return self.get_observables()[0]
@@ -1063,9 +944,6 @@ class Navier2D(Integrate):
 
     def eval_re(self) -> float:
         return self.get_observables()[2]
-
-    def div_norm(self) -> float:
-        return self.get_observables()[3]
 
     def write(self, filename: str) -> None:
         """Write a flow snapshot in the reference HDF5 layout."""
@@ -1081,37 +959,6 @@ class Navier2D(Integrate):
 
         checkpoint.read_snapshot(self, filename)
 
-    # -- sharded (shard-wise) snapshot surface -------------------------------
-    # utils/checkpoint's distributed two-phase writer/reader drives these:
-    # each process fetches only its addressable shards, so checkpoints work
-    # on multi-controller meshes where np.asarray(state) cannot.
-
-    def snapshot_state_items(self) -> list:
-        """``(name, device_array)`` for every state leaf the sharded
-        checkpoint must carry — the full restart set (``pseu`` included, so
-        a restore is bit-equal to the writer's state, not merely
-        restart-equivalent)."""
-        return [
-            (f"state/{name}", getattr(self.state, name))
-            for name in self.state._fields
-        ]
-
-    def snapshot_root_items(self) -> list:
-        """Replicated host-side data for the sharded manifest root (the
-        HostSnapshot ``datasets`` tuple convention)."""
-        items = [("time", np.asarray(float(self.time), dtype=np.float64), "raw")]
-        for key, value in self.params.items():
-            items.append((key, np.asarray(float(value), dtype=np.float64), "raw"))
-        return items
-
-    def apply_restored_state(self, updates: dict, attrs: dict, root: dict) -> None:
-        """Install state leaves assembled by the sharded reader (already
-        placed in this model's target layout) + the manifest's time."""
-        self.state = self.state._replace(**updates)
-        self.time = float(np.asarray(root["time"]))
-        self._obs_cache = None
-        self._pre_div_latch = False
-
     def read_unwrap(self, filename: str) -> None:
         from ..utils.checkpoint import CheckpointError
 
@@ -1124,32 +971,3 @@ class Navier2D(Integrate):
         from ..utils import navier_io
 
         navier_io.callback(self)
-
-    def exit(self) -> bool:
-        """NaN-divergence break criterion
-        (/root/reference/src/navier_stokes/navier.rs:482-489), extended by
-        the pre-divergence latch: a CFL-ceiling catch (sentinels armed)
-        reads as a break until a governor clears it — so an *ungoverned*
-        ``integrate`` over a sentinel-armed model stops cleanly at the
-        rolled-back (finite) state instead of looping forever."""
-        if self._pre_div_latch:
-            return True
-        return bool(np.isnan(self.div_norm()))
-
-    def exit_future(self):
-        """Non-blocking form of :meth:`exit` for the overlapped driver
-        (utils/integrate.py ``overlap``): a latched pre-divergence catch
-        resolves immediately (host-side fact); otherwise the break flag
-        rides the cached observables dispatch and is fetched when the
-        driver gets around to it — typically one chunk later, after the
-        next chunk is already in flight."""
-        from ..utils.io_pipeline import MappedFuture, immediate
-
-        if self._pre_div_latch:
-            return immediate(True)
-        return MappedFuture(
-            self.get_observables_async(), lambda vals: bool(np.isnan(vals[3]))
-        )
-
-    def reset_time(self) -> None:
-        self.time = 0.0

@@ -34,6 +34,7 @@ from .. import config
 from ..field import norm_l2
 from ..solver import Hholtz
 from ..utils.integrate import Integrate
+from .campaign import CampaignModelBase
 from .navier import Navier2D, NavierState
 
 RES_TOL = 1e-7  # steady_adjoint.rs:60
@@ -53,8 +54,20 @@ class AdjointState(NamedTuple):
     res_norms: jax.Array  # (3,): |velx_adj|, |vely_adj|, |temp_adj|
 
 
-class Navier2DAdjoint(Integrate):
-    """Steady-state RBC solver; same parameter vocabulary as Navier2D."""
+class Navier2DAdjoint(CampaignModelBase, Integrate):
+    """Steady-state RBC solver; same parameter vocabulary as Navier2D.
+
+    A full campaign model (models/campaign.py): the whole adjoint-descent
+    iteration is hoisted into ``_step_cc``, so steady-state finds run as
+    vmapped K-member ensembles under ``ResilientRunner`` — and since the
+    residual norms ride the state, RESIDUAL CONVERGENCE is compiled into
+    the scanned chunk's early-exit (:meth:`_scan_ok`): a member whose mean
+    smoothed residual drops below ``res_tol`` freezes at its converged
+    state mid-chunk, costing no further GEMMs — the residual-based exit
+    sentinel of the steady-find workload (workloads/steady.py)."""
+
+    MODEL_KIND = "adjoint"
+    observable_names = ("res", "res_u", "res_t", "div")
 
     def __init__(
         self,
@@ -67,18 +80,19 @@ class Navier2DAdjoint(Integrate):
         bc: str,
         periodic: bool = False,
         mesh=None,
+        res_tol: float = RES_TOL,
     ):
         # the embedded forward model is built at DT_NAVIER so its implicit
         # Helmholtz solvers carry the correct dt (steady_adjoint.rs:286-300)
         self.navier = Navier2D(nx, ny, ra, pr, DT_NAVIER, aspect, bc, periodic, mesh=mesh)
         self.mesh = mesh
         self.dt = dt
-        self.time = 0.0
+        self.res_tol = float(res_tol)
         self.params = self.navier.params
         self.scale = self.navier.scale
         self.write_intervall: float | None = None
         self.statistics = None
-        self._obs_cache = None
+        self._init_campaign()
 
         nav = self.navier
         sx2, sy2 = self.scale[0] ** 2, self.scale[1] ** 2
@@ -100,6 +114,92 @@ class Navier2DAdjoint(Integrate):
                 pres_adj=zero,
                 res_norms=jnp.full((3,), np.inf, dtype=config.real_dtype()),
             )
+
+    @property
+    def nx(self) -> int:
+        return self.navier.nx
+
+    @property
+    def ny(self) -> int:
+        return self.navier.ny
+
+    def _compat_fields(self) -> tuple:
+        # self.dt is the DESCENT pseudo-step (the inner forward model runs
+        # at the fixed DT_NAVIER); res_tol is compiled into the chunk's
+        # convergence early-exit, so it buckets too
+        return (
+            int(self.navier.nx),
+            int(self.navier.ny),
+            float(self.params["ra"]),
+            float(self.params["pr"]),
+            float(self.dt),
+            float(self.scale[0]),
+            str(self.navier.bc),
+            bool(self.navier.periodic),
+            # variant slot: only a NON-default tolerance buckets separately
+            # (so registry-built default models match kind-prefixed request
+            # keys, which cannot express a custom tolerance)
+            () if self.res_tol == RES_TOL else (("res_tol", float(self.res_tol)),),
+        )
+
+    def _gspmd_split_sep_fallback(self) -> bool:
+        return self.navier._gspmd_split_sep_fallback()
+
+    def restart_fill(self, name: str, like):
+        """Gathered-restore fill: residual norms restart at +inf (unknown —
+        zero would read as instantly converged), everything else at zero."""
+        if name == "res_norms":
+            return jnp.full_like(like, np.inf)
+        return jnp.zeros_like(like)
+
+    # space delegates (checkpoint layer vocabulary)
+    @property
+    def temp_space(self):
+        return self.navier.temp_space
+
+    @property
+    def velx_space(self):
+        return self.navier.velx_space
+
+    @property
+    def vely_space(self):
+        return self.navier.vely_space
+
+    @property
+    def pres_space(self):
+        return self.navier.pres_space
+
+    @property
+    def pseu_space(self):
+        return self.navier.pseu_space
+
+    @property
+    def field_space(self):
+        return self.navier.field_space
+
+    @property
+    def x(self):
+        return self.navier.x
+
+    def _scan_ok(self, state):
+        """Continue while finite AND unconverged: the mean smoothed-residual
+        convergence test (steady_adjoint.rs:624-638) compiled into the
+        scanned chunk — a converged state freezes mid-chunk (identity
+        steps), which is the workload's exit sentinel."""
+        finite = jnp.isfinite(jnp.sum(state.temp))
+        return finite & (jnp.mean(state.res_norms) >= self.res_tol)
+
+    def _scan_done_ok(self, state):
+        """A member that stopped advancing CONVERGED (rather than died)
+        when its residual is finite and below tolerance."""
+        res = jnp.mean(state.res_norms)
+        return jnp.isfinite(res) & (res < self.res_tol)
+
+    def _scan_commit_ok(self, state):
+        """Commit any FINITE candidate: convergence stops the member (via
+        ``_scan_ok``) but the converged state is the answer and must land
+        in the carry before the freeze."""
+        return jnp.isfinite(jnp.sum(state.temp))
 
     # -- construction ---------------------------------------------------------
 
@@ -124,11 +224,13 @@ class Navier2DAdjoint(Integrate):
 
     # -- the adjoint iteration ------------------------------------------------
 
-    def _make_step(self):
+    def _make_step(self, with_sentinels: bool = False):
         nav = self.navier
         dt = self.dt
         scale = nav.scale
         nu, ka = nav.params["nu"], nav.params["ka"]
+        inv_dx, inv_dy = nav._inv_dx, nav._inv_dy
+        w0s, w1s = nav._w0, nav._w1
         sp_t, sp_u, sp_v = nav.temp_space, nav.velx_space, nav.vely_space
         sp_p, sp_q, sp_f = nav.pres_space, nav.pseu_space, nav.field_space
         from ..bases import fused_projection_gradient
@@ -168,6 +270,14 @@ class Navier2DAdjoint(Integrate):
             # *** adjoint descent step (steady_adjoint.rs:584-605)
             ux = sp_u.backward(ns.velx)
             uy = sp_v.backward(ns.vely)
+
+            if with_sentinels:
+                # advective CFL of the embedded FORWARD step (the stiff,
+                # explicitly-convected part of the iteration) + flow KE
+                cfl = DT_NAVIER * jnp.max(
+                    jnp.abs(ux) * inv_dx[:, None] + jnp.abs(uy) * inv_dy[None, :]
+                )
+                ke = 0.5 * jnp.sum((ux**2 + uy**2) * w0s[:, None] * w1s[None, :])
             uxa = sp_u.backward(velx_adj)
             uya = sp_v.backward(vely_adj)
             ta = sp_t.backward(temp_adj)
@@ -242,20 +352,44 @@ class Navier2DAdjoint(Integrate):
             rhs = rhs + dt * ka * lap(sp_t, temp_adj)
             temp_n = sp_t.from_ortho(rhs)
 
-            return AdjointState(
+            state_n = AdjointState(
                 temp_n, velx_n, vely_n, ns.pres, pseu_n, pres_adj_n, res_norms
             )
+            if with_sentinels:
+                return state_n, (cfl, ke, norm_l2(div))
+            return state_n
 
         return step
 
-    def _compile_entry_points(self) -> None:
+    def _make_observables(self):
+        """Fused convergence diagnostics ``(res, res_u, res_t, |div|)``:
+        the mean smoothed-residual norm (the convergence measure,
+        steady_adjoint.rs:633) plus its velocity/temperature components —
+        all riding the state carry, so the per-chunk convergence check
+        costs no extra dispatch — and the velocity divergence norm as the
+        NaN detector."""
+        nav = self.navier
+        sp_u, sp_v = nav.velx_space, nav.vely_space
+        scale = nav.scale
+
+        def observables(state: AdjointState):
+            res = jnp.mean(state.res_norms)
+            div = norm_l2(
+                sp_u.gradient(state.velx, (1, 0), scale)
+                + sp_v.gradient(state.vely, (0, 1), scale)
+            )
+            return res, state.res_norms[0], state.res_norms[2], div
+
+        return observables
+
+    def _state_example(self):
         nav = self.navier
         rdt = config.real_dtype()
 
         def sds(space):
             return jax.ShapeDtypeStruct(space.shape_spectral, space.spectral_dtype())
 
-        example = AdjointState(
+        return AdjointState(
             temp=sds(nav.temp_space),
             velx=sds(nav.velx_space),
             vely=sds(nav.vely_space),
@@ -264,21 +398,6 @@ class Navier2DAdjoint(Integrate):
             pres_adj=sds(nav.pres_space),
             res_norms=jax.ShapeDtypeStruct((3,), rdt),
         )
-        from ..utils.jit import hoist_constants
-
-        with nav._scope():
-            step_cc, consts = hoist_constants(self._make_step(), example)
-        self._consts = consts
-        step_jit = jax.jit(step_cc)
-        self._step = lambda s: step_jit(self._consts, s)
-
-        def step_n(consts, state, n: int):
-            return jax.lax.scan(
-                lambda c, _: (step_cc(consts, c), None), state, None, length=n
-            )[0]
-
-        step_n_jit = jax.jit(step_n, static_argnames=("n",))
-        self._step_n = lambda s, n: step_n_jit(self._consts, s, n=n)
 
     # -- field access (delegates keep the Navier2D vocabulary) ---------------
 
@@ -293,11 +412,14 @@ class Navier2DAdjoint(Integrate):
         self.navier._obs_cache = None
 
     def _pull_navier(self) -> None:
-        """Adopt navier.state (after set_field/read) into the adjoint state."""
+        """Adopt navier.state (after set_field/read) into the adjoint state
+        (residual norms reset — they describe the previous iterate)."""
         ns = self.navier.state
         self.state = self.state._replace(
-            temp=ns.temp, velx=ns.velx, vely=ns.vely, pres=ns.pres, pseu=ns.pseu
+            temp=ns.temp, velx=ns.velx, vely=ns.vely, pres=ns.pres, pseu=ns.pseu,
+            res_norms=jnp.full((3,), np.inf, dtype=config.real_dtype()),
         )
+        self._obs_cache = None
 
     def set_velocity(self, amp, m, n):
         self.navier.set_velocity(amp, m, n)
@@ -316,6 +438,13 @@ class Navier2DAdjoint(Integrate):
         return self.navier.get_field(name)
 
     def read(self, filename: str) -> None:
+        from ..utils import checkpoint
+
+        if checkpoint.is_sharded_checkpoint(filename):
+            # manifest restore targets THIS model's snapshot surface (every
+            # AdjointState leaf incl. pres_adj/res_norms — bit-exact resume)
+            checkpoint.read_sharded_snapshot(self, filename)
+            return
         self.navier.read(filename)
         self._pull_navier()
         self.time = self.navier.time
@@ -325,24 +454,9 @@ class Navier2DAdjoint(Integrate):
         self.navier.write(filename)
 
     # -- Integrate protocol ---------------------------------------------------
-
-    def update(self) -> None:
-        with self.navier._scope():
-            self.state = self._step(self.state)
-        self.time += self.dt
-
-    def update_n(self, n: int) -> None:
-        from ..utils.jit import run_scanned
-
-        with self.navier._scope():
-            self.state = run_scanned(self._step_n, self.state, n)
-        self.time += n * self.dt
-
-    def get_time(self) -> float:
-        return self.time
-
-    def get_dt(self) -> float:
-        return self.dt
+    # update/update_n/update_n_pending, sentinels, set_dt (rung-cached; the
+    # descent dt only lives in the compiled step — _rebuild_dt_artifacts is
+    # the base recompile) and observable futures come from CampaignModelBase
 
     def norm_residual(self) -> tuple[float, float, float]:
         """Smoothed-residual norms (|u*_x|, |u*_y|, |theta*|)
@@ -353,21 +467,19 @@ class Navier2DAdjoint(Integrate):
         """Mean residual — the convergence measure (steady_adjoint.rs:633)."""
         return float(np.mean(np.asarray(self.state.res_norms)))
 
-    def get_observables(self):
-        self._sync_navier()
-        return self.navier.get_observables()
-
     def eval_nu(self):
-        return self.get_observables()[0]
+        """Nusselt of the current iterate (DNS vocabulary, via the embedded
+        model; the campaign observables are the residual norms)."""
+        self._sync_navier()
+        return self.navier.get_observables()[0]
 
     def eval_nuvol(self):
-        return self.get_observables()[1]
+        self._sync_navier()
+        return self.navier.get_observables()[1]
 
     def eval_re(self):
-        return self.get_observables()[2]
-
-    def div_norm(self):
-        return self.get_observables()[3]
+        self._sync_navier()
+        return self.navier.get_observables()[2]
 
     def callback(self) -> None:
         from ..utils import navier_io
@@ -387,14 +499,13 @@ class Navier2DAdjoint(Integrate):
         )
 
     def exit(self) -> bool:
-        """NaN divergence, or converged: mean residual < RES_TOL
-        (steady_adjoint.rs:624-638)."""
-        if np.isnan(self.div_norm()):
+        """NaN divergence (or a latched sentinel catch), or converged: mean
+        residual < ``res_tol`` (steady_adjoint.rs:624-638).  A converged
+        exit is a SUCCESS — :meth:`state_healthy` (the checkpoint guard)
+        deliberately keeps reporting True for it."""
+        if super().exit():
             return True
-        if self.residual() < RES_TOL:
+        if self.residual() < self.res_tol:
             print("Steady state converged!")
             return True
         return False
-
-    def reset_time(self) -> None:
-        self.time = 0.0

@@ -135,6 +135,26 @@ class DurableQueue:
                 return req.compat_key
         return None
 
+    def bucket_order(self) -> list[tuple]:
+        """Distinct pending bucket keys ordered by their OLDEST queued
+        request (FIFO over buckets) — the scheduler's round-robin rotation
+        walks this list so no bucket waits more than one full cycle."""
+        with self._lock:
+            order: list[tuple] = []
+            for _, req in self._load_queued():
+                if req.compat_key not in order:
+                    order.append(req.compat_key)
+            return order
+
+    def other_bucket_waiting(self, key: tuple) -> bool:
+        """True when some OTHER bucket holds queued work (the fairness
+        quantum only caps a campaign while someone is actually waiting)."""
+        with self._lock:
+            for _, req in self._load_queued():
+                if req.compat_key != key:
+                    return True
+        return False
+
     def claim(self, key: tuple | None = None) -> SimRequest | None:
         """Atomically move the oldest queued request (matching ``key`` when
         given) into ``running/`` and return it; None when nothing matches."""

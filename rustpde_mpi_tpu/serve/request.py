@@ -64,7 +64,16 @@ class RequestFailed(RuntimeError):
 
 @dataclasses.dataclass
 class SimRequest:
-    """One simulation request (Ra/Pr/resolution/geometry/horizon).
+    """One simulation request (model kind + Ra/Pr/resolution/geometry/horizon).
+
+    ``model`` names the physics through the workloads registry (``"dns"``
+    DNS, ``"lnse"`` linearized eigenmode run, ``"adjoint"`` steady-state
+    find) — it PREFIXES :attr:`compat_key`, so mixed-model traffic buckets
+    into separate campaigns by construction.  ``scenario`` optionally adds
+    DNS step modifiers (``coriolis`` / ``passive_scalar`` /
+    ``scalar_kappa`` — workloads/modifiers.ScenarioConfig.to_dict()); the
+    modifier terms are operator constants, so the scenario signature joins
+    the bucket key too.
 
     ``horizon`` is sim-time; the scheduler converts it to a step count at
     admission (``steps = max(1, round(horizon / dt))``).  ``dt`` may be
@@ -83,6 +92,8 @@ class SimRequest:
     aspect: float = 1.0
     bc: str = "rbc"
     periodic: bool = False
+    model: str = "dns"  # workloads-registry kind
+    scenario: dict | None = None  # DNS step modifiers (compat-key signed)
     seed: int = 0
     amp: float | None = None  # IC amplitude (None: ServeConfig.default_amp)
     id: str = ""
@@ -112,13 +123,44 @@ class SimRequest:
             raise RequestError(f"horizon must be positive, got {self.horizon}")
         if not (self.ra > 0.0 and self.pr > 0.0):
             raise RequestError(f"Ra/Pr must be positive, got {self.ra}/{self.pr}")
+        from ..workloads.registry import model_kinds
+
+        if self.model not in model_kinds():
+            raise RequestError(
+                f"unknown model kind {self.model!r}; known: {list(model_kinds())}"
+            )
+        if self.scenario is not None:
+            if self.model != "dns":
+                raise RequestError(
+                    "scenario modifiers are a DNS axis (model='dns')"
+                )
+            known = {"coriolis", "passive_scalar", "scalar_kappa"}
+            unknown = set(self.scenario) - known
+            if unknown:
+                raise RequestError(
+                    f"unknown scenario fields: {sorted(unknown)}"
+                )
+            # VALUE validation: the signature computation must succeed —
+            # compat_key is evaluated after admission (journal, bucket
+            # ordering), so a bad-typed value admitted here would become a
+            # durable poison pill that crashes every serve() pass
+            from ..models.navier import scenario_signature
+
+            try:
+                scenario_signature(self.scenario)
+            except (TypeError, ValueError) as exc:
+                raise RequestError(f"bad scenario values: {exc}") from exc
         return self
 
     @property
     def compat_key(self) -> tuple:
-        """Operator-constant bucket key — equal keys co-batch (see
-        :attr:`Navier2D.compat_key`; same field order)."""
+        """Operator-constant bucket key — equal keys co-batch (mirrors
+        :attr:`~rustpde_mpi_tpu.models.campaign.CampaignModelBase.compat_key`:
+        model kind first, canonical scenario signature last)."""
+        from ..models.navier import scenario_signature
+
         return (
+            str(self.model),
             int(self.nx),
             int(self.ny),
             float(self.ra),
@@ -127,6 +169,7 @@ class SimRequest:
             float(self.aspect),
             str(self.bc),
             bool(self.periodic),
+            scenario_signature(self.scenario),
         )
 
     @property

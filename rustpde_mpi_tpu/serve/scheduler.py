@@ -60,8 +60,8 @@ import numpy as np
 
 from ..config import IOConfig, ServeConfig
 from ..models.ensemble import NavierEnsemble
-from ..models.navier import Navier2D
 from ..utils import checkpoint
+from ..workloads.registry import build_model_for_key
 from ..utils.faults import FaultPlan, validate_fault_env
 from ..utils.journal import JournalWriter, read_journal
 from ..utils.resilience import ResilientRunner
@@ -134,6 +134,10 @@ class SimServer:
         )
         self._drain = False
         self._runner: ResilientRunner | None = None
+        # bucket fairness: the key served by the previous campaign (the
+        # round-robin cursor) + this campaign's claim budget consumption
+        self._last_bucket: tuple | None = None
+        self._campaign_claims = 0
         self._t0 = time.monotonic()
         self._global_step = 0  # member-chunk steps across campaigns
         self._member_steps = 0  # aggregate member-steps actually computed
@@ -235,7 +239,7 @@ class SimServer:
         )
         try:
             while not self._drain:
-                key = self.queue.oldest_bucket()
+                key = self._next_bucket()
                 if key is None:
                     if self.cfg.idle_exit:
                         break
@@ -328,13 +332,30 @@ class SimServer:
 
     # -- campaign -------------------------------------------------------------
 
+    def _next_bucket(self) -> tuple | None:
+        """Round-robin bucket selection (the fairness half of the ROADMAP
+        item): buckets are ordered by their oldest queued request, and the
+        pick ROTATES past the previously-served bucket — so under a
+        daemon-mode mixed workload a hot bucket whose requests keep
+        arriving cannot be re-picked while other buckets wait.  With one
+        bucket (or none after it) this degrades to oldest-first."""
+        order = self.queue.bucket_order()
+        if not order:
+            return None
+        if self._last_bucket in order and len(order) > 1:
+            i = order.index(self._last_bucket)
+            return order[(i + 1) % len(order)]
+        return order[0]
+
     def _campaign_dir(self, key: tuple) -> str:
         tag = hashlib.sha1(repr(key).encode()).hexdigest()[:12]
         return os.path.join(self.cfg.run_dir, "campaigns", tag)
 
     def _build_runner(self, key: tuple) -> tuple[ResilientRunner, _ServedEnsemble]:
-        nx, ny, ra, pr, dt, aspect, bc, periodic = key
-        model = Navier2D(nx, ny, ra, pr, dt, aspect, bc, periodic=periodic)
+        # the bucket key IS the model spec: kind-prefixed, scenario-signed —
+        # the workloads registry builds whatever physics the bucket needs
+        # (DNS with/without modifiers, lnse, adjoint)
+        model = build_model_for_key(key)
         model.write_intervall = float("inf")  # no flow-file callback IO
         ens = _ServedEnsemble(model, [model.state] * int(self.cfg.slots))
         ens.mark_dead(range(ens.k))  # all lanes idle until a request lands
@@ -368,6 +389,8 @@ class SimServer:
     def _run_campaign(self, key: tuple) -> None:
         runner, ens = self._build_runner(key)
         self._runner = runner
+        self._last_bucket = key  # round-robin cursor
+        self._campaign_claims = 0  # fairness quantum consumption
         if self._drain:  # a signal raced the build
             runner.request_drain()
         try:
@@ -467,15 +490,37 @@ class SimServer:
     def _fill_slots(self, runner, ens, slots: list[_Slot], key: tuple) -> None:
         """Refill every idle lane from this bucket's queue (fresh IC via
         the template model's generator; ``set_member`` installs it without
-        recompiling)."""
+        recompiling).
+
+        Bucket fairness: one campaign visit claims at most
+        ``cfg.bucket_quantum`` requests while OTHER buckets hold queued
+        work — past the quantum the refill stops, the campaign drains its
+        running slots and ends, and the round-robin pick serves the next
+        bucket (this bucket's tail gets its next turn).  With no competing
+        bucket the quantum is waived (no reason to cycle)."""
         if self._drain:
             return
+        quantum = int(self.cfg.bucket_quantum)
         for slot in slots:
             if slot.running:
                 continue
+            if (
+                quantum > 0
+                and self._campaign_claims >= quantum
+                and self.queue.other_bucket_waiting(key)
+            ):
+                self._journal(
+                    {
+                        "event": "bucket_quantum",
+                        "key": list(key),
+                        "claims": self._campaign_claims,
+                    }
+                )
+                return
             req = self.queue.claim(key)
             if req is None:
                 return
+            self._campaign_claims += 1
             state = ens.fresh_member_state(req.seed, req.amp or self.cfg.default_amp)
             ens.set_member(slot.index, state)
             slot.req = req
@@ -536,19 +581,31 @@ class SimServer:
         so the fetched values are the finished members' final states."""
         alive = ens.alive()
         done = np.asarray(ens.steps_done)
+        # a member that stopped advancing via the model's SUCCESS criterion
+        # (the adjoint finder's residual convergence) finished early — it is
+        # a completion, not a death, even below its step target
+        done_ok = ens.done_ok_members()
         finished = [
             s for s in slots
-            if s.running and alive[s.index] and int(done[s.index]) >= s.target
+            if s.running and (
+                (alive[s.index] and int(done[s.index]) >= s.target)
+                or done_ok[s.index]
+            )
         ]
-        dead = [s for s in slots if s.running and not alive[s.index]]
+        dead = [
+            s for s in slots
+            if s.running and not alive[s.index] and not done_ok[s.index]
+        ]
         if finished:
             obs_fut = ens.get_observables_async()
+            names = tuple(ens.observable_names)
             batch = []
             for s in finished:
                 batch.append(
                     {
                         "slot": s.index,
                         "req": s.req,
+                        "names": names,
                         "steps": int(done[s.index]),
                         "finished_wall": time.time(),
                         "step": runner.step,
@@ -613,25 +670,33 @@ class SimServer:
             if not force and not fut.ready():
                 keep.append((fut, batch))
                 continue
-            nu, nuvol, re, div = fut.result()
+            values = fut.result()
             for item in batch:
                 req: SimRequest = item["req"]
                 i = item["slot"]
+                # result scalars carry the MODEL's observable vocabulary
+                # (dns: nu/nuvol/re/div; lnse: energy/ke/te/div; adjoint:
+                # res/res_u/res_t/div) — recorded under those names
+                names = item["names"]
                 result = {
-                    "nu": float(nu[i]),
-                    "nuvol": float(nuvol[i]),
-                    "re": float(re[i]),
-                    "div": float(div[i]),
-                    "steps": item["steps"],
-                    "dt": float(req.dt),
-                    "seed": int(req.seed),
-                    # IC amplitude rides the record so solo-equivalence
-                    # checks rerun the exact trajectory
-                    "amp": float(req.amp) if req.amp else None,
-                    "retries": int(req.retries),
-                    "slot": i,
-                    "latency_s": round(item["finished_wall"] - req.submitted_s, 6),
+                    name: float(vals[i]) for name, vals in zip(names, values)
                 }
+                result.update(
+                    {
+                        "model": str(req.model),
+                        "steps": item["steps"],
+                        "dt": float(req.dt),
+                        "seed": int(req.seed),
+                        # IC amplitude rides the record so solo-equivalence
+                        # checks rerun the exact trajectory
+                        "amp": float(req.amp) if req.amp else None,
+                        "retries": int(req.retries),
+                        "slot": i,
+                        "latency_s": round(
+                            item["finished_wall"] - req.submitted_s, 6
+                        ),
+                    }
+                )
                 self.queue.complete(req, result)
                 self._completed += 1
                 self._journal(
@@ -640,7 +705,7 @@ class SimServer:
                         "id": req.id,
                         "slot": i,
                         "steps": item["steps"],
-                        "nu": result["nu"],
+                        names[0]: result[names[0]],
                         "latency_s": result["latency_s"],
                         "step": item["step"],
                     }

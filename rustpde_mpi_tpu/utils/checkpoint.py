@@ -597,16 +597,17 @@ def snapshot_to_host(model, step: int | None = None) -> HostSnapshot:
     on the io_pipeline worker, off the dispatch critical path."""
     xs, dxs = _model_coords(model)
     datasets: list = []
+    model_vars = getattr(model, "snapshot_vars", _VARS)
     with model._scope():
         phys = {
             attr: getattr(model, f"{attr}_space").backward(
                 getattr(model.state, attr)
             )
-            for _, attr in _VARS
+            for _, attr in model_vars
         }
         tempbc = getattr(model, "tempbc_ortho", None)
         phys_bc = model.field_space.backward(tempbc) if tempbc is not None else None
-        for varname, attr in _VARS:
+        for varname, attr in model_vars:
             space = getattr(model, f"{attr}_space")
             datasets += _field_host_datasets(
                 varname, space, getattr(model.state, attr), phys[attr], xs, dxs
@@ -630,6 +631,7 @@ def ensemble_snapshot_to_host(ens, step: int | None = None) -> HostSnapshot:
     model = ens.model
     xs, dxs = _model_coords(model)
     datasets: list = []
+    model_vars = getattr(model, "snapshot_vars", _VARS)
     with model._scope():
         phys = {
             attr: [
@@ -638,12 +640,12 @@ def ensemble_snapshot_to_host(ens, step: int | None = None) -> HostSnapshot:
                 )
                 for i in range(ens.k)
             ]
-            for _, attr in _VARS
+            for _, attr in model_vars
         }
         tempbc = getattr(model, "tempbc_ortho", None)
         phys_bc = model.field_space.backward(tempbc) if tempbc is not None else None
         for i in range(ens.k):
-            for varname, attr in _VARS:
+            for varname, attr in model_vars:
                 space = getattr(model, f"{attr}_space")
                 datasets += _field_host_datasets(
                     f"member{i}/{varname}",
@@ -729,12 +731,12 @@ def read_ensemble_snapshot(ens, filename: str) -> None:
     import jax
     import jax.numpy as jnp
 
-    from ..models.navier import NavierState
-
     if is_sharded_checkpoint(filename):
         read_sharded_snapshot(ens, filename)
         return
     model = ens.model
+    model_vars = getattr(model, "snapshot_vars", _VARS)
+    state_cls = type(model.state)
     with _open_checkpoint(filename) as h5:
         _verify_open_file(h5, filename)
         k = int(np.asarray(h5["members"]))
@@ -745,14 +747,22 @@ def read_ensemble_snapshot(ens, filename: str) -> None:
             except KeyError as exc:
                 raise _missing(h5, f"member{i}") from exc
             updates = {}
-            for varname, attr in _VARS:
+            for varname, attr in model_vars:
                 space = getattr(model, f"{attr}_space")
                 vhat = read_field_vhat(grp, varname, space)
                 updates[attr] = jnp.asarray(vhat, dtype=space.spectral_dtype())
-            updates["pseu"] = jnp.zeros(
-                model.pseu_space.shape_spectral, model.pseu_space.spectral_dtype()
-            )
-            members.append(NavierState(**updates))
+            for name in state_cls._fields:
+                # leaves the gathered layout does not carry (``pseu``, the
+                # reference layout; auxiliary campaign leaves) restart via
+                # the model's fill rule (default zero) — the gathered format
+                # is restart-equivalent, the sharded manifest is bit-exact
+                if name not in updates:
+                    like = getattr(model.state, name)
+                    fill = getattr(model, "restart_fill", None)
+                    updates[name] = (
+                        fill(name, like) if fill else jnp.zeros_like(like)
+                    )
+            members.append(state_cls(**updates))
         with model._scope():
             ens.state = jax.tree.map(lambda *xs: jnp.stack(xs), *members)
             ens.k = k
@@ -776,11 +786,22 @@ def read_snapshot(model, filename: str) -> None:
     if is_sharded_checkpoint(filename):
         read_sharded_snapshot(model, filename)
         return
+    base_vars = {attr for _, attr in _VARS}
     with _open_checkpoint(filename) as h5:
         _verify_open_file(h5, filename)
         updates = {}
-        for varname, attr in _VARS:
+        for varname, attr in getattr(model, "snapshot_vars", _VARS):
             space = getattr(model, f"{attr}_space")
+            if varname not in h5 and attr not in base_vars:
+                # scenario-extended leaf absent from an older snapshot:
+                # restart it via the model's fill rule (the write side
+                # stores it — snapshot_to_host uses the same var list)
+                fill = getattr(model, "restart_fill", None)
+                like = getattr(model.state, attr)
+                updates[attr] = (
+                    fill(attr, like) if fill else jnp.zeros_like(like)
+                )
+                continue
             vhat = read_field_vhat(h5, varname, space)
             updates[attr] = jnp.asarray(vhat, dtype=space.spectral_dtype())
         model.state = model.state._replace(**updates)

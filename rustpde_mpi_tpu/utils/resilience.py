@@ -473,8 +473,14 @@ class ResilientRunner:
 
     def _state_ok(self) -> bool:
         """Never checkpoint a dead state: a NaN single-run state (or an
-        all-dead ensemble) must not overwrite the rollback target."""
+        all-dead ensemble) must not overwrite the rollback target.  Models
+        distinguishing "exit because done" from "exit because dead" (the
+        steady-state finder converging is a SUCCESS worth checkpointing)
+        expose ``state_healthy``; the break criterion stays ``exit()``."""
+        healthy = getattr(self.pde, "state_healthy", None)
         try:
+            if healthy is not None:
+                return bool(healthy())
             return not self.pde.exit()
         except Exception:
             return False

@@ -1,0 +1,144 @@
+"""Scenario step modifiers + the vmapped solid-mask geometry sweep.
+
+The scenario axis of the DNS: config-carried terms compiled into the
+:class:`~rustpde_mpi_tpu.models.navier.Navier2D` step (so they are operator
+constants and sign into ``compat_key`` — see
+:func:`~rustpde_mpi_tpu.models.navier.scenario_signature`):
+
+* **rotating frame** — the f-plane Coriolis force ``(+f v, -f u)`` added
+  explicitly to the momentum equations.  Analytic validation: in exactly
+  incompressible 2-D flow this force is irrotational (its curl is
+  ``-f div(u) = 0``) and therefore absorbed ENTIRELY by the pressure — the
+  velocity/temperature trajectory matches the non-rotating run while the
+  pressure carries the geostrophic correction (tests/test_workloads.py).
+* **passive scalar** — an advected-diffused scalar leaf riding the
+  temperature's composite space and BC lift, at its own diffusivity
+  (``scalar_kappa``; defaults to the thermal one).  Exact validation: at
+  matched diffusivity a scalar released equal to the temperature stays
+  identically equal for all time (one-way coupling; the scalar sees the
+  same advection-diffusion operator + boundary forcing).
+
+The **geometry sweep** extends the batching axis to solid obstacles: the
+Brinkman penalization is an elementwise post-step map on
+``(temp, velx, vely)`` (the step applies it after the projection, and the
+pressure update never reads the penalized fields), so
+``step_solid = penalize ∘ step_plain`` EXACTLY — which means one compiled
+plain step serves every geometry, with the per-member penalization factors
+vmapped as runtime inputs instead of baked constants.  K obstacle
+geometries advance as one donated vmapped scan, and each member is
+bit-identical to a solo ``set_solid`` run of the same mask.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+
+@dataclasses.dataclass
+class ScenarioConfig:
+    """Config-carried scenario step modifiers for ``Navier2D`` (pass as the
+    model's ``scenario=`` ctor arg, ``NavierConfig.scenario``, or carry the
+    equivalent dict on a :class:`~rustpde_mpi_tpu.serve.SimRequest`).
+
+    * ``coriolis`` — rotating-frame f-plane rate ``f`` (0 = off); adds
+      ``(+f v, -f u)`` to the momentum equations,
+    * ``passive_scalar`` — add the advected scalar state leaf,
+    * ``scalar_kappa`` — scalar diffusivity (None: the thermal diffusivity,
+      the matched configuration whose scalar mirrors the temperature)."""
+
+    coriolis: float = 0.0
+    passive_scalar: bool = False
+    scalar_kappa: float | None = None
+
+    @property
+    def signature(self) -> tuple:
+        """The canonical compat-key signature (models/navier.py)."""
+        from ..models.navier import scenario_signature
+
+        return scenario_signature(self)
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+def penalization_factors(model, mask, value=None, eta: float | None = None):
+    """The pointwise implicit-Brinkman factors ``(fac, temp_add)`` for one
+    obstacle — literally :func:`~rustpde_mpi_tpu.models.navier.brinkman_factors`,
+    the SAME implementation ``Navier2D.set_solid`` bakes into its step (the
+    sweep's bit-match-solo guarantee rests on never forking it)."""
+    from ..models.navier import brinkman_factors
+
+    return brinkman_factors(model, mask, value, eta)
+
+
+def geometry_sweep(model, geometries, steps: int, states=None):
+    """Advance K obstacle geometries as ONE vmapped donated scan.
+
+    ``model`` — a plain (no ``set_solid``) :class:`Navier2D` whose hoisted
+    step jaxpr is shared by every member; ``geometries`` — a list of
+    ``(mask, value)`` pairs (models/solid_masks.py builders) or ``mask``
+    arrays; ``states`` — optional per-member initial states (default: K
+    copies of ``model.state``).
+
+    Returns ``(stacked_state, observables)`` where ``observables`` is the
+    model's ``(K,)``-shaped observable tuple of the final states.  Each
+    member equals a solo ``set_solid(mask, value)`` run EXACTLY (the
+    penalize-after-step factoring is an identity, not an approximation —
+    asserted in tests/test_workloads.py)."""
+    import jax
+    import jax.numpy as jnp
+
+    if getattr(model, "_solid", None) is not None:
+        raise ValueError(
+            "geometry_sweep needs a plain template model; the sweep itself "
+            "supplies the per-member penalization (set_solid(None) first)"
+        )
+    pairs = []
+    for geom in geometries:
+        mask, value = geom if isinstance(geom, tuple) else (geom, None)
+        pairs.append(penalization_factors(model, mask, value))
+    if not pairs:
+        raise ValueError("geometry_sweep needs at least one geometry")
+    facs = jnp.stack([p[0] for p in pairs])
+    adds = jnp.stack([p[1] for p in pairs])
+    k = len(pairs)
+    if states is None:
+        members = [model.state] * k
+    else:
+        members = list(states)
+        if len(members) != k:
+            raise ValueError(f"{len(members)} states for {k} geometries")
+    with model._scope():
+        stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *members)
+
+    step_cc = model._step_cc
+    consts = model._step_consts
+    sp_u, sp_v, sp_t = model.velx_space, model.vely_space, model.temp_space
+
+    def member_step(state, fac, add):
+        new = step_cc(consts, state)
+        # the exact set_solid composition: penalize (temp, velx, vely) of
+        # the stepped state; pres/pseu are untouched by the penalization
+        return new._replace(
+            velx=sp_u.forward(sp_u.backward(new.velx) * fac),
+            vely=sp_v.forward(sp_v.backward(new.vely) * fac),
+            temp=sp_t.forward(sp_t.backward(new.temp) * fac + add),
+        )
+
+    vstep = jax.vmap(member_step, in_axes=(0, 0, 0))
+
+    def sweep(stacked, facs, adds, n: int):
+        def body(carry, _):
+            return vstep(carry, facs, adds), None
+
+        return jax.lax.scan(body, stacked, None, length=int(n))[0]
+
+    sweep_jit = jax.jit(sweep, static_argnames=("n",), donate_argnums=(0,))
+    with model._scope():
+        final = sweep_jit(stacked, facs, adds, n=int(steps))
+        obs = jax.jit(jax.vmap(model._obs_cc, in_axes=(None, 0)))(
+            model._obs_consts, final
+        )
+    return final, tuple(np.asarray(v) for v in obs)

@@ -1,0 +1,163 @@
+"""Steady-state finder campaign: adjoint descent as a batched, resilient
+workload.
+
+:class:`~rustpde_mpi_tpu.models.steady_adjoint.Navier2DAdjoint` descends
+the smoothed-residual norm toward a steady state; as a CampaignModel its
+residual norms ride the state carry, so residual CONVERGENCE is the
+chunk's compiled early-exit (``_scan_ok``): a member that reaches
+``res_tol`` freezes at its converged state mid-chunk — no wasted GEMMs, no
+host round-trip per iteration.  This module drives K seed-decorrelated
+finds as one vmapped ensemble under
+:class:`~rustpde_mpi_tpu.utils.resilience.ResilientRunner`: sharded
+checkpoints on a cadence, auto-resume (a mid-find SIGTERM/kill resumes the
+descent from the newest valid checkpoint — exercised by the workload gate
+in tests/test_workloads.py), and per-member fault isolation (one diverged
+IC cannot kill its co-batched finds).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def build_steady_ensemble(
+    *,
+    nx: int,
+    ny: int,
+    ra: float,
+    pr: float = 1.0,
+    dt: float = 5e-3,
+    aspect: float = 1.0,
+    bc: str = "rbc",
+    periodic: bool = False,
+    res_tol: float | None = None,
+    k: int = 1,
+    amp: float = 0.5,
+    seeds=None,
+    mesh=None,
+):
+    """K member adjoint finders: member 0 is seeded on the large-scale
+    circulation mode (the reference's IC, steady_adjoint.rs doc example),
+    further members on random ICs (``seeds``, default 1..K-1) — basins of
+    attraction differ, so a batch explores several candidate states."""
+    from ..models.ensemble import NavierEnsemble
+    from ..models.steady_adjoint import RES_TOL
+    from .registry import build_model
+
+    model = build_model(
+        "adjoint", nx, ny, ra, pr, dt, aspect, bc, periodic, mesh=mesh,
+        scenario={"res_tol": float(res_tol if res_tol is not None else RES_TOL)},
+    )
+    members = []
+    model.set_temperature(amp, 1.0, 1.0)
+    model.set_velocity(amp, 1.0, 1.0)
+    members.append(model.state)
+    seeds = list(seeds) if seeds is not None else list(range(1, k))
+    for seed in seeds[: max(0, k - 1)]:
+        model.init_random(amp, seed=int(seed))
+        members.append(model.state)
+    return NavierEnsemble(model, members)
+
+
+def steady_state_find(
+    *,
+    nx: int = 17,
+    ny: int = 17,
+    ra: float = 100.0,
+    pr: float = 1.0,
+    dt: float = 1e-3,
+    aspect: float = 1.0,
+    bc: str = "rbc",
+    periodic: bool = False,
+    res_tol: float = 1e-7,
+    k: int = 1,
+    amp: float = 0.5,
+    seeds=None,
+    max_iters: int = 20000,
+    chunk: int = 200,
+    run_dir: str = "data/steady_find",
+    checkpoint_every_s: float | None = None,
+    checkpoint_every_iters: int | None = None,
+    fault: str | None = None,
+    stability=None,
+    mesh=None,
+    install_signals: bool = True,
+) -> dict:
+    """Run a K-member steady-state find to convergence (or ``max_iters``).
+
+    The exit sentinel is the residual: each chunk's per-member residuals
+    arrive with the (already-dispatched) observables, members freeze
+    on-device at convergence, and the campaign ends when every member is
+    converged or dead.  With ``run_dir`` checkpoints + auto-resume are on:
+    re-invoking after a kill CONTINUES the find mid-descent.
+
+    Returns ``{"converged" (per member), "residuals", "nu", "iterations",
+    "resumed", "checkpoint"}``."""
+    from ..config import IOConfig
+    from ..utils.resilience import ResilientRunner
+
+    ens = build_steady_ensemble(
+        nx=nx, ny=ny, ra=ra, pr=pr, dt=dt, aspect=aspect, bc=bc,
+        periodic=periodic, res_tol=res_tol, k=k, amp=amp, seeds=seeds,
+        mesh=mesh,
+    )
+    runner = ResilientRunner(
+        ens,
+        max_time=float("inf"),
+        run_dir=run_dir,
+        checkpoint_every_s=checkpoint_every_s,
+        stability=stability,
+        fault=fault if fault is not None else "",
+        io=IOConfig(sharded_checkpoints=True, overlap_dispatch=False),
+    )
+    preempted = False
+    with runner.session(install_signals=install_signals):
+        last_ckpt_step = runner.step
+        while runner.step < max_iters:
+            res = np.asarray(ens.get_observables()[0])
+            done = ens.done_ok_members()
+            # a member is finished when converged (done) or dead (NaN
+            # residual/field); the pristine +inf residual means "not yet"
+            if bool((done | np.isnan(res) | (res < res_tol)).all()):
+                break
+            before = runner.step
+            runner.advance(min(chunk, max_iters - runner.step))
+            if runner.step == before:
+                break  # no progress (all members frozen inside the chunk)
+            if runner.on_boundary() or runner.drain_requested():
+                preempted = True
+                break  # drain/preempt: checkpoint-then-exit below
+            if (
+                checkpoint_every_iters
+                and runner.step - last_ckpt_step >= checkpoint_every_iters
+            ):
+                runner.checkpoint_now("cadence_iters")
+                last_ckpt_step = runner.step
+        final_res = np.asarray(ens.get_observables()[0])
+        converged = np.isfinite(final_res) & (final_res < res_tol)
+        if converged.any() or preempted:
+            # the converged state is the ANSWER (and a preempted descent
+            # must resume mid-trajectory): persist it durably
+            runner.checkpoint_now("preempt" if preempted else "final")
+    nus = []
+    for i in range(ens.k):
+        try:
+            # Nusselt of each member's final iterate (DNS vocabulary)
+            member = ens.member_state(i)
+            ens.model.state = ens.model.state._replace(
+                temp=member.temp, velx=member.velx, vely=member.vely,
+                pres=member.pres, pseu=member.pseu,
+            )
+            ens.model._obs_cache = None
+            nus.append(float(ens.model.eval_nu()))
+        except Exception:
+            nus.append(float("nan"))
+    return {
+        "converged": [bool(c) for c in converged],
+        "residuals": [float(r) for r in final_res],
+        "nu": nus,
+        "iterations": int(runner.step),
+        "preempted": preempted,
+        "resumed": bool(runner.resumed),
+        "checkpoint": runner._last_ckpt_path,
+    }

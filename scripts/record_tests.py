@@ -102,6 +102,10 @@ def main() -> int:
         # the durability harness's footprint rides the test record so a
         # shard-layout regression is visible across PRs
         "sharded_io": _sharded_io_counters(),
+        # per-model solo-vs-ensemble parity deltas (workloads satellite):
+        # recorded into PARITY.json too, so cross-model vmap/scan drift
+        # shows up per-PR next to the Nu-parity numbers
+        "workloads": _workloads_parity(),
         "date": _utc_now(),
     }
     _persist(record)
@@ -172,6 +176,54 @@ def _sharded_io_counters() -> dict | None:
         }
     except (OSError, ValueError, KeyError):
         return None
+
+
+_WORKLOADS_CHILD = r"""
+import json, os, sys
+os.environ["JAX_PLATFORMS"] = "cpu"
+sys.path.insert(0, %(repo)r)
+import jax
+jax.config.update("jax_platforms", "cpu")
+from rustpde_mpi_tpu.workloads import solo_ensemble_parity
+print("WORKLOADS_JSON " + json.dumps(solo_ensemble_parity(steps=6)))
+"""
+
+
+def _workloads_parity() -> dict | None:
+    """Per-model-kind solo-vs-ensemble parity deltas (max relative state
+    deviation of a K=2 vmapped campaign vs member-wise solo runs, per
+    registered model kind), computed in a CPU child and merged into
+    PARITY.json under ``"workloads"``.  Best-effort: a failure records the
+    error string instead of killing the test record."""
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", _WORKLOADS_CHILD % {"repo": _REPO}],
+            capture_output=True,
+            text=True,
+            timeout=600,
+            cwd=_REPO,
+        )
+        line = next(
+            ln for ln in proc.stdout.splitlines()
+            if ln.startswith("WORKLOADS_JSON ")
+        )
+        deltas = json.loads(line[len("WORKLOADS_JSON "):])
+    except Exception as exc:  # noqa: BLE001 — recording must not fail the run
+        return {"error": f"{type(exc).__name__}: {exc}"}
+    payload = {"deltas": deltas, "date": _utc_now()}
+    # merge into PARITY.json next to the Nu-parity trajectories
+    parity_path = os.path.join(_REPO, "PARITY.json")
+    try:
+        with open(parity_path) as f:
+            parity = json.load(f)
+    except (OSError, ValueError):
+        parity = {}
+    parity["workloads"] = payload
+    tmp = f"{parity_path}.{os.getpid()}.tmp"
+    with open(tmp, "w") as f:
+        json.dump(parity, f, indent=1)
+    os.replace(tmp, parity_path)
+    return payload
 
 
 def _utc_now() -> str:

@@ -37,6 +37,8 @@ _CASES = {
         "--run-dir", "data/serve_smoke", "--fault", "nan@3",
     ],
     "navier_rbc_roughness.py": ["--quick"],
+    "navier_rbc_scenarios.py": ["--quick"],
+    "navier_lnse_eigenmodes.py": ["--quick", "--run-dir", "data/eig_smoke"],
     "navier_mpi.py": ["--quick"],
     "navier_rbc_steady.py": ["--quick"],
     "navier_rbc_steady_continuation.py": [

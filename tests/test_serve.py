@@ -505,6 +505,95 @@ def test_drain_checkpoint_with_changed_slots_degrades_gracefully(tmp_path):
         assert res["nu"] == pytest.approx(_solo_nu(res), rel=1e-9)
 
 
+def _solo_lnse_energy(result):
+    """Solo rerun of one lnse done-record's trajectory through the
+    workloads registry — the mixed-campaign isolation ground truth."""
+    from rustpde_mpi_tpu.workloads import build_model
+
+    m = build_model("lnse", 17, 17, 1e4, 1.0, result["dt"], 1.0, "rbc", False)
+    m.init_random(result.get("amp") or 0.1, seed=result["seed"])
+    m.update_n(result["steps"])
+    return float(m.get_observables()[0])
+
+
+def test_serve_mixed_model_campaign(tmp_path):
+    """The multi-model serving contract end-to-end: DNS and lnse requests
+    through ONE server — the kind-prefixed compat key buckets them into
+    separate registry-built campaigns, every request resolves (zero lost),
+    results carry each model's own observable vocabulary, and per-request
+    isolation holds against solo ground truth for BOTH kinds."""
+    srv = SimServer(_cfg(tmp_path, slots=2))
+    dns_ids = [srv.submit(dict(_REQ, seed=s)).id for s in range(2)]
+    lnse_ids = [
+        srv.submit(dict(_REQ, model="lnse", seed=s, amp=1e-3)).id
+        for s in range(2)
+    ]
+    summary = srv.serve()
+    assert summary["completed"] == 4 and summary["failed"] == 0
+    assert srv.queue.counts() == {"queued": 0, "running": 0, "done": 4, "failed": 0}
+    for rid in dns_ids:
+        res = srv.result(rid)
+        assert res["model"] == "dns" and "nu" in res
+        assert res["nu"] == pytest.approx(_solo_nu(res), rel=1e-9)
+    for rid in lnse_ids:
+        res = srv.result(rid)
+        assert res["model"] == "lnse" and "energy" in res and "nu" not in res
+        assert res["energy"] == pytest.approx(_solo_lnse_energy(res), rel=1e-9)
+    # two separate campaigns ran (one per model-kind bucket)
+    events = _events(srv.cfg.run_dir)
+    keys = [tuple(e["key"]) for e in events if e["event"] == "campaign_start"]
+    assert {k[0] for k in keys} == {"dns", "lnse"}
+    # malformed model kinds die at admission, before any compile
+    with pytest.raises(RequestError, match="unknown model kind"):
+        srv.submit(dict(_REQ, model="nope"))
+    with pytest.raises(RequestError, match="DNS axis"):
+        srv.submit(dict(_REQ, model="lnse", scenario={"coriolis": 1.0}))
+    # bad scenario VALUES die at admission too — compat_key is evaluated
+    # after admission, so a bad-typed value admitted here would be a
+    # durable poison pill crashing every later serve() pass
+    with pytest.raises(RequestError, match="bad scenario values"):
+        srv.submit(dict(_REQ, scenario={"coriolis": "fast"}))
+    with pytest.raises(RequestError, match="bad scenario values"):
+        srv.submit(
+            dict(_REQ, scenario={"passive_scalar": True, "scalar_kappa": 0.0})
+        )
+    assert srv.queue.counts()["queued"] == 0  # nothing poisonous persisted
+
+
+def test_serve_bucket_fairness_no_starvation(tmp_path):
+    """The fairness regression (ROADMAP-flagged): two buckets with skewed
+    arrivals — 6 hot-bucket requests queued ahead of 2 cold-bucket ones.
+    With round-robin bucket selection + the claim quantum, the cold bucket
+    is served after one quantum of the hot one instead of waiting for its
+    whole backlog: every cold request completes before the hot tail is even
+    scheduled."""
+    srv = SimServer(_cfg(tmp_path, slots=2, bucket_quantum=2))
+    hot = [srv.submit(dict(_REQ, seed=s)).id for s in range(6)]
+    cold = [srv.submit(dict(_REQ, dt=0.005, seed=s)).id for s in range(2)]
+    summary = srv.serve()
+    assert summary["completed"] == 8 and summary["failed"] == 0
+
+    events = _events(srv.cfg.run_dir)
+    order = [
+        (e["event"], e["id"]) for e in events
+        if e["event"] in ("request_scheduled", "request_done")
+    ]
+    last_cold_done = max(
+        i for i, (ev, rid) in enumerate(order)
+        if ev == "request_done" and rid in cold
+    )
+    hot_sched = [
+        i for i, (ev, rid) in enumerate(order)
+        if ev == "request_scheduled" and rid in hot
+    ]
+    # the hot tail (claims 5..6) was scheduled only AFTER the cold bucket
+    # fully completed — the quantum actually preempted the hot campaign
+    assert sum(1 for i in hot_sched if i < last_cold_done) <= 4
+    assert sum(1 for i in hot_sched if i > last_cold_done) >= 2
+    names = [e["event"] for e in events]
+    assert "bucket_quantum" in names  # the cap fired, not a coincidence
+
+
 def test_public_robustness_api_exports():
     """The README-documented robustness surface must be importable from the
     package root (satellite: pin the API)."""

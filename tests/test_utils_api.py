@@ -62,6 +62,37 @@ def test_step_timer():
     assert s["steps_per_sec_min"] <= s["steps_per_sec_max"]
 
 
+def test_workload_api_exports():
+    """The workloads satellite: the multi-model campaign surface must be
+    importable from the package root (API pin — mirrors the robustness pin
+    in test_serve.py)."""
+    import rustpde_mpi_tpu as rp
+
+    for name in (
+        "CampaignModelBase",
+        "ScenarioConfig",
+        "build_model",
+        "model_kinds",
+        "register_model_kind",
+        "validate_campaign_model",
+        "eigenmode_sweep",
+        "critical_rayleigh",
+        "steady_state_find",
+        "geometry_sweep",
+        "Navier2DLnse",
+        "Navier2DAdjoint",
+    ):
+        assert hasattr(rp, name), name
+    assert set(rp.model_kinds()) >= {"dns", "lnse", "adjoint"}
+    # the models package exports the campaign contract + both ported models
+    from rustpde_mpi_tpu import models as mdl
+
+    for name in ("CampaignModelBase", "CAMPAIGN_MODEL_ATTRS",
+                 "Navier2DLnse", "Navier2DAdjoint", "AdjointState",
+                 "NavierScalarState", "scenario_signature"):
+        assert hasattr(mdl, name), name
+
+
 def test_transfer_function_limits():
     """Smooth three-level transfer (boundary_conditions.rs:262-274): hits
     v_l at the left edge, v_m in the middle, v_r at the right edge."""
