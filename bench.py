@@ -334,6 +334,62 @@ def bench_governor(nx, ny, ra, dt, steps):
     r_plain = {"steps_per_sec": 1e3 / ms_plain}
     r_sent = {"steps_per_sec": 1e3 / ms_sent}
 
+    # telemetry overhead gate (PR 8): metrics+tracing ON vs OFF through the
+    # RUNNER advance path (where the spans/counters/SLO live — bare
+    # update_n never touches telemetry).  Unlike the sentinel leg there is
+    # no differing fixed cost to cancel — ON and OFF execute the IDENTICAL
+    # dispatch path, only the telemetry branches differ — so no slope
+    # timing: one large matched window per rep (16 sub-chunks of L steps =
+    # one telemetry round per sub-chunk, the production cadence),
+    # interleaved, min-of-reps.  Gates: <=2% wall overhead AND bit-equal
+    # observables (telemetry records host scalars the run already fetched;
+    # it must never perturb the traced programs).
+    from rustpde_mpi_tpu import ResilientRunner as _Runner
+    from rustpde_mpi_tpu import telemetry
+
+    tel_window = 16 * L  # 16 telemetry rounds per timed window
+    tel_dirs = [tempfile.mkdtemp(prefix="bench_tel_") for _ in range(2)]
+    try:
+        runners = {}
+        for key, d in (("on", tel_dirs[0]), ("off", tel_dirs[1])):
+            runners[key] = _Runner(
+                build(StabilityConfig()),
+                max_time=float("inf"),
+                run_dir=d,
+                checkpoint_every_s=None,
+                max_chunk_steps=L,  # one span/counter round per L steps
+            )
+        # save/restore each layer's own flag: restoring both from the
+        # metrics flag would re-enable tracing a user pinned off via
+        # RUSTPDE_TRACE=0
+        tel_prev = (telemetry.metrics_enabled(), telemetry.tracing_enabled())
+        tel_walls = {"on": [], "off": []}
+        try:
+            for key, r in runners.items():  # compile + warm the chunk shapes
+                telemetry.set_enabled(key == "on")
+                r.advance(tel_window)
+                _jax.block_until_ready(r.pde.state)
+            for _ in range(5):
+                for key, r in runners.items():
+                    telemetry.set_enabled(key == "on")
+                    t0 = time.perf_counter()
+                    r.advance(tel_window)
+                    _jax.block_until_ready(r.pde.state)
+                    tel_walls[key].append(time.perf_counter() - t0)
+        finally:
+            telemetry.set_metrics_enabled(tel_prev[0])
+            telemetry.set_tracing_enabled(tel_prev[1])
+        tel_overhead = min(tel_walls["on"]) / min(tel_walls["off"]) - 1.0
+        # bit-equality: both runners stepped the identical IC the identical
+        # number of steps — telemetry must not have changed a single bit
+        nu_on = float(runners["on"].pde.eval_nu())
+        nu_off = float(runners["off"].pde.eval_nu())
+        tel_bit_equal = bool(nu_on == nu_off)
+    finally:
+        for d in tel_dirs:
+            shutil.rmtree(d, ignore_errors=True)
+    tel_ok = bool(tel_overhead <= 0.02)
+
     # probe the CFL the flow will have AT the spike step (the early flow is
     # far calmer than the developed one the overhead window ends in), then
     # size the spike to ~6x the ceiling: violently nonlinear, so an
@@ -408,6 +464,9 @@ def bench_governor(nx, ny, ra, dt, steps):
         "plain_steps_per_sec": r_plain["steps_per_sec"],
         "sentinel_overhead_x": 1.0 + overhead,
         "sentinel_overhead_ok": overhead_ok,
+        "telemetry_overhead_x": 1.0 + tel_overhead,
+        "telemetry_overhead_ok": tel_ok,
+        "telemetry_bit_equal": tel_bit_equal,
         "cfl_base": cfl_base,
         "spike_factor": spike_factor,
         "governed_retries": g_summary["retries"],
@@ -422,7 +481,13 @@ def bench_governor(nx, ny, ra, dt, steps):
         "ungoverned_retries": ungoverned_retries,
         "nu": g_summary["nu"],
         "steps": spike_steps,
-        "finite": bool(recovered and ungoverned_suffered and overhead_ok),
+        "finite": bool(
+            recovered
+            and ungoverned_suffered
+            and overhead_ok
+            and tel_ok
+            and tel_bit_equal
+        ),
     }
 
 

@@ -20,6 +20,8 @@ Daemon mode with the HTTP front (Ctrl-C drains gracefully)::
     python examples/navier_rbc_serve.py --daemon --http-port 8808
     curl -X POST localhost:8808/requests -d '{"ra":1e4,"nx":17,"ny":17,"dt":0.01,"horizon":0.2}'
     curl localhost:8808/stats
+    curl localhost:8808/metrics    # live Prometheus exposition (telemetry/)
+    curl localhost:8808/healthz    # liveness + queue depth + slot utilization
 """
 
 import argparse

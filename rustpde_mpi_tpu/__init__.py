@@ -64,6 +64,11 @@ from .workloads import (  # noqa: F401
     steady_state_find,
     validate_campaign_model,
 )
+from . import telemetry  # noqa: F401
+from .telemetry import (  # noqa: F401
+    MetricsRegistry,
+    ThroughputMonitor,
+)
 from .utils.checkpoint import CheckpointError  # noqa: F401
 from .utils.faults import FaultSpecError  # noqa: F401
 from .utils.resilience import (  # noqa: F401

@@ -713,13 +713,16 @@ class NavierEnsemble(Integrate):
     def _emit_callback_line(self, t: float, vals, alive: np.ndarray) -> None:
         """Diagnostics append + aggregate print for one boundary (shared by
         the synchronous path and the io_pipeline's lagged emission)."""
-        nu, nuvol, re, div = vals
+        nu, nuvol, re, div = vals[:4]
+        # extended vocabularies (the passive-scalar sherwood) append by name
+        extra_names = tuple(self.observable_names)[4:]
         for key, val in (
             ("time", [t] * self.k),
             ("nu", nu),
             ("nuvol", nuvol),
             ("re", re),
             ("div", div),
+            *zip(extra_names, vals[4:]),
             ("alive", alive.astype(float)),
         ):
             self.diagnostics.setdefault(key, []).append(list(map(float, val)))

@@ -146,7 +146,17 @@ class Navier2D(CampaignModelBase, Integrate):
     passive-scalar transport)."""
 
     MODEL_KIND = "dns"
-    observable_names = ("nu", "nuvol", "re", "div")
+
+    @property
+    def observable_names(self) -> tuple:
+        """The fused-observables vocabulary.  A passive-scalar scenario
+        appends ``sherwood`` (the scalar-transfer analog of the plate-flux
+        Nusselt number) AFTER the conventional four — index 3 stays the
+        NaN-detector |div| every consumer keys on."""
+        base = ("nu", "nuvol", "re", "div")
+        if self._scalar_active():
+            return base + ("sherwood",)
+        return base
 
     def __init__(
         self,
@@ -878,6 +888,17 @@ class Navier2D(CampaignModelBase, Integrate):
                 # observable (a scal-only NaN is invisible to the flow —
                 # exit()/state_healthy/serve isolation all watch dnorm)
                 dnorm = dnorm + 0.0 * jnp.sum(jnp.abs(state.scal))
+                # Sherwood number: the scalar-transfer analog of the
+                # plate-flux Nu — the scalar shares the temperature's
+                # composite space AND BC lift, so at matched diffusivity a
+                # scalar released equal to T yields sherwood == nu exactly
+                # (the scenario's validation identity).  Appended AFTER the
+                # conventional four so |div| stays the index-3 NaN detector.
+                shat = sp_t.to_ortho(state.scal) + tb
+                dsdy_p = sp_f.backward_gradient(shat, (0, 1), None)
+                s_avg = avg_x(dsdy_p) * (-2.0 / scale[1])
+                sherwood = 0.5 * (s_avg[0] + s_avg[-1])
+                return nu_plate, nu_vol, re, dnorm, sherwood
             return nu_plate, nu_vol, re, dnorm
 
         return observables

@@ -1,15 +1,20 @@
 """Thin HTTP front for the simulation service (stdlib only).
 
 A :class:`~http.server.ThreadingHTTPServer` on a daemon thread, speaking a
-five-endpoint JSON protocol over the :class:`~.scheduler.SimServer`'s
+six-endpoint JSON protocol over the :class:`~.scheduler.SimServer`'s
 thread-safe surface::
 
     POST /requests        {"ra":1e4,"horizon":0.1,...}  -> 202 {"id": ...}
                           429 {"error","reason"} on admission rejection
-                          400 on a malformed request body
+                          400 on a malformed request body / bad
+                          Content-Length / truncated body, 413 oversized
     GET  /requests/<id>   lifecycle record               (404 unknown)
     GET  /stats           queue counts + throughput counters
-    GET  /healthz         {"ok": true, "draining": ...}
+    GET  /healthz         {"ok", "draining", "queue", "slots"} — liveness
+                          plus queue depth and slot utilization, so an
+                          orchestrator can see back-pressure, not just "up"
+    GET  /metrics         Prometheus text exposition of the live registry
+                          (telemetry/exporters.py) — point a scraper here
     POST /drain           ask the service to drain       -> 202
 
 Durability lives BELOW this layer: a submit is acknowledged only after the
@@ -24,16 +29,25 @@ import json
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
+from ..telemetry import metrics as _tm
+from ..telemetry.exporters import PROMETHEUS_CONTENT_TYPE, prometheus_text
 from .request import AdmissionError, RequestError
+
+#: request bodies past this are rejected with 413 before any parse — a
+#: SimRequest is a handful of scalars; megabyte bodies are abuse or bugs
+MAX_BODY_BYTES = 1 << 20
 
 
 class HttpFront:
     """Lifecycle wrapper: ``start()`` binds (port 0 = ephemeral, see
     ``address``), ``stop()`` shuts the listener down.  Handlers call the
-    server's thread-safe methods only."""
+    server's thread-safe methods only.  ``registry`` defaults to the
+    process-wide telemetry registry rendered by ``GET /metrics``."""
 
-    def __init__(self, sim_server, host: str = "127.0.0.1", port: int = 0):
+    def __init__(self, sim_server, host: str = "127.0.0.1", port: int = 0,
+                 registry=None):
         self.sim = sim_server
+        self.registry = registry if registry is not None else _tm.default_registry()
         self._httpd = ThreadingHTTPServer((host, port), self._make_handler())
         self._httpd.daemon_threads = True
         self._thread: threading.Thread | None = None
@@ -57,8 +71,15 @@ class HttpFront:
 
     def _make_handler(self):
         sim = self.sim
+        registry = self.registry
 
         class Handler(BaseHTTPRequestHandler):
+            # socket timeout (socketserver applies it in setup()): a client
+            # that promises a body and then goes SILENT — without hanging
+            # up — must not wedge a handler thread forever; the 400/413
+            # checks below only cover malformed/oversized/EOF frames
+            timeout = 30.0
+
             def log_message(self, fmt, *args):  # quiet: the journal is the log
                 pass
 
@@ -70,10 +91,33 @@ class HttpFront:
                 self.end_headers()
                 self.wfile.write(body)
 
+            def _reply_text(self, code: int, text: str, content_type: str) -> None:
+                body = text.encode("utf-8")
+                self.send_response(code)
+                self.send_header("Content-Type", content_type)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
             def do_GET(self):
+                registry.counter(
+                    "http_requests_total", "HTTP requests served", method="GET"
+                ).inc()
                 if self.path == "/healthz":
+                    # enriched liveness: queue depth + slot utilization ride
+                    # along, so "up but drowning" is visible to the prober
                     return self._reply(
-                        200, {"ok": True, "draining": sim._drain}
+                        200,
+                        {
+                            "ok": True,
+                            "draining": sim.draining,
+                            "queue": sim.queue.counts(),
+                            "slots": sim.slot_info(),
+                        },
+                    )
+                if self.path == "/metrics":
+                    return self._reply_text(
+                        200, prometheus_text(registry), PROMETHEUS_CONTENT_TYPE
                     )
                 if self.path == "/stats":
                     return self._reply(200, sim.stats())
@@ -84,15 +128,49 @@ class HttpFront:
                     return self._reply(200, status)
                 return self._reply(404, {"error": "unknown endpoint"})
 
+            def _read_body(self):
+                """Validated request body, or (code, error) on a broken
+                frame: non-integer/negative Content-Length -> 400,
+                oversized -> 413, truncated (client hung up early) -> 400.
+                Never trusts the header for the read — the socket read is
+                capped and the byte count re-checked."""
+                raw = self.headers.get("Content-Length", "0")
+                try:
+                    length = int(raw)
+                except (TypeError, ValueError):
+                    return None, (400, f"bad Content-Length: {raw!r}")
+                if length < 0:
+                    return None, (400, f"bad Content-Length: {raw!r}")
+                if length > MAX_BODY_BYTES:
+                    return None, (
+                        413,
+                        f"request body of {length} bytes exceeds the "
+                        f"{MAX_BODY_BYTES}-byte limit",
+                    )
+                body = self.rfile.read(length)
+                if len(body) != length:
+                    return None, (
+                        400,
+                        f"truncated body: Content-Length {length}, "
+                        f"got {len(body)} bytes",
+                    )
+                return body, None
+
             def do_POST(self):
+                registry.counter(
+                    "http_requests_total", "HTTP requests served", method="POST"
+                ).inc()
                 if self.path == "/drain":
                     sim.request_drain()
                     return self._reply(202, {"draining": True})
                 if self.path != "/requests":
                     return self._reply(404, {"error": "unknown endpoint"})
+                body, err = self._read_body()
+                if err is not None:
+                    code, message = err
+                    return self._reply(code, {"error": message})
                 try:
-                    length = int(self.headers.get("Content-Length", "0"))
-                    data = json.loads(self.rfile.read(length) or b"{}")
+                    data = json.loads(body or b"{}")
                     req = sim.submit(data)
                 except AdmissionError as exc:
                     return self._reply(

@@ -60,13 +60,16 @@ import numpy as np
 
 from ..config import IOConfig, ServeConfig
 from ..models.ensemble import NavierEnsemble
+from ..telemetry import metrics as _tm
+from ..telemetry import tracing as _tr
+from ..telemetry.exporters import MetricsDumper
 from ..utils import checkpoint
 from ..workloads.registry import build_model_for_key
 from ..utils.faults import FaultPlan, validate_fault_env
 from ..utils.journal import JournalWriter, read_journal
 from ..utils.resilience import ResilientRunner
 from .queue import DurableQueue
-from .request import RequestFailed, SimRequest
+from .request import AdmissionError, RequestFailed, SimRequest
 
 
 class _ServedEnsemble(NavierEnsemble):
@@ -147,6 +150,13 @@ class SimServer:
         self._pending_results: list[tuple] = []  # (obs_future, [(slot,req,..)])
         self._prev_handlers: dict = {}
         self._http = None
+        # live serve telemetry (telemetry/metrics.py): slot occupancy of the
+        # ACTIVE campaign, the member-rate mark for the steps/s + MFU gauges,
+        # and the per-member step flops of the campaign model (trace-only
+        # jaxpr count, computed once per campaign build)
+        self._slots_state: tuple[int, int] = (0, int(self.cfg.slots))
+        self._rate_mark: tuple[float, int] = (time.monotonic(), 0)
+        self._flops_member: float | None = None
 
     # -- client surface -------------------------------------------------------
 
@@ -164,14 +174,25 @@ class SimServer:
             )
         if req.amp is None:
             req.amp = float(self.cfg.default_amp)
-        self.queue.submit(req, admit_open=not self._drain)
+        try:
+            self.queue.submit(req, admit_open=not self._drain)
+        except AdmissionError as exc:
+            _tm.counter(
+                "serve_admission_rejected_total",
+                "submits rejected by admission control",
+                reason=exc.reason,
+            ).inc()
+            raise
+        queued = self.queue.counts()["queued"]
+        _tm.counter("serve_requests_admitted_total", "requests admitted").inc()
+        _tm.gauge("serve_queue_depth", "requests waiting in queued/").set(queued)
         self._journal(
             {
                 "event": "request_admitted",
                 "id": req.id,
                 "key": list(req.compat_key),
                 "steps": req.steps,
-                "queued": self.queue.counts()["queued"],
+                "queued": queued,
             }
         )
         return req
@@ -207,6 +228,22 @@ class SimServer:
         if runner is not None:
             runner.request_drain()
 
+    @property
+    def draining(self) -> bool:
+        """Public drain flag (the HTTP front's ``/healthz`` reads this —
+        handlers must never reach into scheduler internals)."""
+        return self._drain
+
+    def slot_info(self) -> dict:
+        """Occupancy of the ACTIVE campaign's ensemble lanes (between
+        campaigns: 0 running over the configured slot count)."""
+        running, total = self._slots_state
+        return {
+            "running": running,
+            "total": total,
+            "utilization": (running / total) if total else 0.0,
+        }
+
     def stats(self) -> dict:
         return {
             "queue": self.queue.counts(),
@@ -216,6 +253,7 @@ class SimServer:
             "member_steps": self._member_steps,
             "wall_s": round(time.monotonic() - self._t0, 3),
             "draining": self._drain,
+            "slots": self.slot_info(),
         }
 
     # -- service loop ---------------------------------------------------------
@@ -272,6 +310,11 @@ class SimServer:
                 "journal": self.journal_path,
             }
             self._journal({"event": "server_stop", **summary})
+            # service-level metrics flush: one jsonl line at the service
+            # root (campaign runners dump their own under campaigns/<key>)
+            MetricsDumper(
+                os.path.join(self.cfg.run_dir, "metrics.jsonl")
+            ).dump(step=self._global_step)
             self._journal_writer.close()  # reopens lazily if used again
             self._stop_http()
             self._restore_signals()
@@ -340,6 +383,9 @@ class SimServer:
         arriving cannot be re-picked while other buckets wait.  With one
         bucket (or none after it) this degrades to oldest-first."""
         order = self.queue.bucket_order()
+        _tm.gauge(
+            "serve_bucket_occupancy", "distinct compat buckets with queued work"
+        ).set(len(order))
         if not order:
             return None
         if self._last_bucket in order and len(order) > 1:
@@ -357,6 +403,14 @@ class SimServer:
         # (DNS with/without modifiers, lnse, adjoint)
         model = build_model_for_key(key)
         model.write_intervall = float("inf")  # no flow-file callback IO
+        # per-member step flops for the live MFU gauge: the trace-only jaxpr
+        # dot count (no extra compile; the entry points were just built)
+        try:
+            from ..utils.profiling import step_flops
+
+            self._flops_member = step_flops(model, method="jaxpr")
+        except Exception:
+            self._flops_member = None
         ens = _ServedEnsemble(model, [model.state] * int(self.cfg.slots))
         ens.mark_dead(range(ens.k))  # all lanes idle until a request lands
         rcfg = self.cfg.resilience
@@ -407,10 +461,12 @@ class SimServer:
                     }
                 )
                 self._fill_slots(runner, ens, slots, key)
+                self._refresh_slot_state(slots, ens.k)
                 self._campaign_loop(runner, ens, slots, key)
         finally:
             self._global_step = runner.step
             self._runner = None
+            self._slots_state = (0, int(self.cfg.slots))
 
     def _try_resume(self, runner) -> None:
         """Campaign restore with graceful degradation: a checkpoint that no
@@ -487,6 +543,18 @@ class SimServer:
             )
         return slots
 
+    def _refresh_slot_state(self, slots: list[_Slot], total: int) -> None:
+        """Keep ``slot_info()`` (/healthz) AND the Prometheus gauge honest
+        the moment lanes are claimed/released — not just at chunk
+        boundaries, where the first (compile-heavy) chunk would report 0
+        running for many seconds and a post-settle sample would
+        under-report lanes the refill is about to reclaim."""
+        running = sum(1 for s in slots if s.running)
+        self._slots_state = (running, total)
+        _tm.gauge(
+            "serve_slot_utilization", "running slots / campaign slot count"
+        ).set((running / total) if total else 0.0)
+
     def _fill_slots(self, runner, ens, slots: list[_Slot], key: tuple) -> None:
         """Refill every idle lane from this bucket's queue (fresh IC via
         the template model's generator; ``set_member`` installs it without
@@ -538,6 +606,30 @@ class SimServer:
                 }
             )
 
+    def _boundary_gauges(self) -> None:
+        """Refresh the live queue/throughput gauges at one chunk boundary —
+        host-side bookkeeping the scheduler already holds (slot occupancy
+        is kept by :meth:`_refresh_slot_state` at claim/release time, so
+        the gauge and ``slot_info()`` can never disagree)."""
+        _tm.gauge("serve_queue_depth", "requests waiting in queued/").set(
+            self.queue.counts()["queued"]
+        )
+        now = time.monotonic()
+        mark_t, mark_steps = self._rate_mark
+        if now > mark_t and self._member_steps > mark_steps:
+            rate = (self._member_steps - mark_steps) / (now - mark_t)
+            _tm.gauge(
+                "serve_member_steps_per_sec",
+                "aggregate member-steps/s across running slots",
+            ).set(rate)
+            if self._flops_member:
+                from ..utils.profiling import PEAK_FLOPS, peak_flops_key
+
+                _tm.gauge(
+                    "serve_mfu", "model-flops utilization of the active campaign"
+                ).set(self._flops_member * rate / PEAK_FLOPS[peak_flops_key()])
+        self._rate_mark = (now, self._member_steps)
+
     def _campaign_loop(self, runner, ens, slots: list[_Slot], key: tuple) -> None:
         while True:
             running = [s for s in slots if s.running]
@@ -550,10 +642,14 @@ class SimServer:
             )
             n = max(1, n)
             before = runner.step
-            runner.advance(n)
+            with _tr.span("serve_chunk", steps=n, slots=len(running)):
+                runner.advance(n)
             advanced = runner.step - before
             self._member_steps += advanced * len(running)
-            self._settle_boundary(runner, ens, slots, key)
+            with _tr.span("serve_settle", step=runner.step):
+                self._settle_boundary(runner, ens, slots, key)
+            self._refresh_slot_state(slots, ens.k)
+            self._boundary_gauges()
             # boundary housekeeping: deferred sharded commit + cadence
             # checkpoint + the drain/preemption flag — runner.on_boundary is
             # the same hook integrate() would drive
@@ -562,6 +658,7 @@ class SimServer:
                 self._drain_campaign(runner, ens, slots)
                 return
             self._fill_slots(runner, ens, slots, key)
+            self._refresh_slot_state(slots, ens.k)
             self._flush_results()
         self._flush_results(force=True)
         self._journal({"event": "campaign_end", "key": list(key),
@@ -633,6 +730,9 @@ class SimServer:
             retry = req.backed_off(self.cfg.request_dt_backoff)
             self.queue.requeue(retry)
             self._retried += 1
+            _tm.counter(
+                "serve_requests_retried_total", "diverged requests re-queued backed off"
+            ).inc()
             self._journal(
                 {
                     "event": "request_retry",
@@ -650,6 +750,9 @@ class SimServer:
             )
             self.queue.fail(req, reason)
             self._failed += 1
+            _tm.counter(
+                "serve_requests_failed_total", "requests in the typed terminal state"
+            ).inc()
             self._journal(
                 {
                     "event": "request_failed",
@@ -699,6 +802,13 @@ class SimServer:
                 )
                 self.queue.complete(req, result)
                 self._completed += 1
+                _tm.counter(
+                    "serve_requests_completed_total", "requests resolved into done/"
+                ).inc()
+                _tm.histogram(
+                    "serve_request_latency_seconds",
+                    "submit-to-finish latency per completed request",
+                ).observe(result["latency_s"])
                 self._journal(
                     {
                         "event": "request_done",
@@ -718,6 +828,7 @@ class SimServer:
         then re-enqueue every unfinished request (progress stamped for the
         record; the checkpoint is what actually restores it)."""
         self._flush_results(force=True)
+        _tr.instant("drain", step=runner.step)
         running = [s for s in slots if s.running]
         path = None
         if running:
@@ -737,3 +848,6 @@ class SimServer:
                 }
             )
         runner._drain_io()
+        # the SIGTERM-drain incident ships with its timeline, like the
+        # standalone runner's preempt path
+        runner.incident_dump("drain")

@@ -1,0 +1,162 @@
+"""Metric exporters: Prometheus text exposition + cadenced ``metrics.jsonl``.
+
+Two read paths over the live registry (telemetry/metrics.py), chosen by how
+the run is operated:
+
+* **served** — ``GET /metrics`` on the serve layer's
+  :class:`~rustpde_mpi_tpu.serve.http_front.HttpFront` renders
+  :func:`prometheus_text` (exposition format 0.0.4: ``# HELP``/``# TYPE``
+  comments, labeled samples, cumulative histogram ``le`` buckets with
+  ``+Inf``/``_sum``/``_count``) — point any Prometheus scraper at it,
+* **headless** — the resilient runner drops a :class:`MetricsDumper` into
+  its ``run_dir``: one JSON line per cadence tick (default 60 s,
+  ``RUSTPDE_METRICS_DUMP_S``) carrying the full registry snapshot plus the
+  delta since the previous line, force-flushed at run end — so a batch
+  campaign's live metrics land next to its journal without any server.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time as _time
+
+from . import metrics as _metrics
+
+#: Content-Type of the Prometheus text exposition format
+PROMETHEUS_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+
+def _fmt_value(v: float) -> str:
+    if v != v:  # NaN
+        return "NaN"
+    if v in (float("inf"), float("-inf")):
+        return "+Inf" if v > 0 else "-Inf"
+    if float(v) == int(v) and abs(v) < 1e15:
+        return str(int(v))
+    return repr(float(v))
+
+
+def _escape(v) -> str:
+    return (
+        str(v).replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+    )
+
+
+def _fmt_labels(labels: dict) -> str:
+    if not labels:
+        return ""
+    body = ",".join(f'{k}="{_escape(v)}"' for k, v in sorted(labels.items()))
+    return "{" + body + "}"
+
+
+def prometheus_text(registry=None) -> str:
+    """Render a registry in the Prometheus text exposition format (0.0.4).
+
+    Counters/gauges emit one sample per label set; histograms emit the
+    cumulative ``<name>_bucket{le=...}`` series (log-bucket upper edges +
+    ``+Inf``) plus ``<name>_sum`` / ``<name>_count`` — exactly what
+    ``histogram_quantile()`` consumes server-side."""
+    reg = registry if registry is not None else _metrics.default_registry()
+    lines: list[str] = []
+    for name, kind, help, rows in reg.families():
+        if help:
+            lines.append(f"# HELP {name} {help}")
+        lines.append(f"# TYPE {name} {kind}")
+        for labels, metric in rows:
+            if kind in ("counter", "gauge"):
+                lines.append(f"{name}{_fmt_labels(labels)} {_fmt_value(metric.value)}")
+            elif kind == "histogram":
+                for le, count in metric.buckets():
+                    bl = dict(labels, le=_fmt_value(le))
+                    lines.append(f"{name}_bucket{_fmt_labels(bl)} {count}")
+                bl = dict(labels, le="+Inf")
+                lines.append(f"{name}_bucket{_fmt_labels(bl)} {metric.count}")
+                lines.append(f"{name}_sum{_fmt_labels(labels)} {_fmt_value(metric.sum)}")
+                lines.append(f"{name}_count{_fmt_labels(labels)} {metric.count}")
+    return "\n".join(lines) + ("\n" if lines else "")
+
+
+class MetricsDumper:
+    """Cadenced ``metrics.jsonl`` writer for headless runs.
+
+    ``maybe_dump()`` is called from chunk boundaries (already host-side
+    control flow) and appends one line at most every ``every_s`` seconds:
+    ``{"t", "wall_s", "step", "snapshot", "delta"}`` where ``delta`` is
+    the registry delta since this dumper's previous line (rates without a
+    scrape server).  ``dump(force=True)`` flushes unconditionally (run
+    end, drain).  Append-only + line-buffered: a SIGKILL tears at most the
+    line in flight, like the journal."""
+
+    def __init__(
+        self,
+        path: str,
+        every_s: float | None = None,
+        registry=None,
+    ):
+        if every_s is None:
+            env = os.environ.get("RUSTPDE_METRICS_DUMP_S", "")
+            every_s = float(env) if env else 60.0
+        self.path = path
+        self.every_s = float(every_s)
+        self.registry = registry if registry is not None else _metrics.default_registry()
+        self._t0 = _time.monotonic()
+        self._last_dump: float | None = None
+        self._prev_snapshot: dict = {}
+        self.dumps = 0
+
+    def maybe_dump(self, step: int | None = None) -> bool:
+        """Dump when the cadence elapsed (the first call only arms the
+        clock — an empty registry line at t=0 is noise)."""
+        now = _time.monotonic()
+        if self._last_dump is None:
+            self._last_dump = now
+            return False
+        if now - self._last_dump < self.every_s:
+            return False
+        return self.dump(step=step)
+
+    def dump(self, step: int | None = None, force: bool = True) -> bool:
+        del force  # signature symmetry with maybe_dump
+        if not _metrics.enabled():
+            return False
+        snap = self.registry.snapshot()
+        record = {
+            "t": _time.time(),
+            "wall_s": round(_time.monotonic() - self._t0, 3),
+            "step": step,
+            "snapshot": snap,
+            "delta": self.registry.delta(self._prev_snapshot),
+        }
+        try:
+            os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
+            with open(self.path, "a", encoding="utf-8") as fh:
+                fh.write(json.dumps(record) + "\n")
+        except OSError:
+            return False  # metrics IO must never kill the run
+        self._prev_snapshot = snap
+        self._last_dump = _time.monotonic()
+        self.dumps += 1
+        return True
+
+
+def read_metrics_jsonl(path: str) -> list[dict]:
+    """Best-effort reader for ``metrics.jsonl`` (torn trailing line from a
+    SIGKILL mid-append is skipped, like the journal reader)."""
+    records = []
+    try:
+        with open(path, encoding="utf-8") as fh:
+            lines = fh.read().splitlines()
+    except OSError:
+        return []
+    for i, line in enumerate(lines):
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            records.append(json.loads(line))
+        except ValueError:
+            if i == len(lines) - 1:
+                continue  # torn tail
+            raise
+    return records

@@ -1,0 +1,254 @@
+"""Flight-recorder tracing: Chrome/Perfetto trace events in a bounded ring.
+
+The runner/scheduler hot seams are wrapped in :func:`span` context managers
+(chunk dispatch/resolve, checkpoint stage/commit, serve settle/refill).
+Every span lands in a process-wide ring buffer — the **flight recorder** —
+whose contents are dumped as a ``traceEvents`` JSON file (loadable directly
+in Perfetto / ``chrome://tracing``) when something goes wrong:
+
+* a :class:`~rustpde_mpi_tpu.utils.resilience.DispatchHang` or
+  :class:`~rustpde_mpi_tpu.utils.resilience.DivergenceError`,
+* a SIGTERM/preemption drain,
+* any other exception escaping a runner session, and unclean process exit
+  while a session is armed (an ``atexit`` dump armed/disarmed per session),
+
+so every incident ships with the timeline of its last few thousand events
+instead of a bare traceback.  The ring bounds memory (default 4096 events,
+``RUSTPDE_TRACE_EVENTS``); dumping never clears it.
+
+Overhead contract: with tracing disabled (:func:`set_enabled` or
+``RUSTPDE_TRACE=0``) :func:`span` returns a shared no-op context manager —
+one function call and one branch (~ns, no allocation); enabled spans cost
+two ``perf_counter`` reads and one deque append.  Spans wrap HOST-side
+seams only and never add device work, so traced runs stay bit-identical
+(CI-asserted together with the metrics layer)."""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time as _time
+from collections import deque
+
+# RUSTPDE_TELEMETRY=0 is the master kill switch; RUSTPDE_TRACE=0 turns off
+# just the tracing half (metrics keep recording)
+_ENABLED = (
+    os.environ.get("RUSTPDE_TRACE", "1") != "0"
+    and os.environ.get("RUSTPDE_TELEMETRY", "1") != "0"
+)
+
+
+def enabled() -> bool:
+    return _ENABLED
+
+
+def set_enabled(flag: bool) -> None:
+    """Turn span recording on/off globally (``RUSTPDE_TRACE`` env default;
+    the bench overhead gate toggles this together with the metrics flag)."""
+    global _ENABLED
+    _ENABLED = bool(flag)
+
+
+class FlightRecorder:
+    """Bounded ring of Chrome trace events (host-side, thread-safe).
+
+    Events use the ``traceEvents`` JSON schema: complete spans (``ph=X``,
+    microsecond ``ts``/``dur`` relative to recorder start) and instant
+    markers (``ph=i``).  ``tid`` is a stable small integer per thread."""
+
+    def __init__(self, capacity: int | None = None):
+        if capacity is None:
+            capacity = int(os.environ.get("RUSTPDE_TRACE_EVENTS", "4096") or 4096)
+        self.capacity = max(16, int(capacity))
+        self._events: deque = deque(maxlen=self.capacity)
+        self._lock = threading.Lock()
+        self._t0 = _time.perf_counter()
+        self._tids: dict[int, int] = {}
+        self._pid = os.getpid()
+        self.dumped = 0  # dump() calls (tests/ops counters)
+
+    def _tid(self) -> int:
+        ident = threading.get_ident()
+        with self._lock:
+            tid = self._tids.get(ident)
+            if tid is None:
+                tid = len(self._tids)
+                self._tids[ident] = tid
+            return tid
+
+    def now_us(self) -> float:
+        return (_time.perf_counter() - self._t0) * 1e6
+
+    def add_complete(self, name: str, t0_us: float, dur_us: float, args=None) -> None:
+        event = {
+            "name": name,
+            "ph": "X",
+            "ts": round(t0_us, 3),
+            "dur": round(dur_us, 3),
+            "pid": self._pid,
+            "tid": self._tid(),
+        }
+        if args:
+            event["args"] = args
+        with self._lock:
+            self._events.append(event)
+
+    def add_instant(self, name: str, args=None) -> None:
+        event = {
+            "name": name,
+            "ph": "i",
+            "s": "g",  # global-scope instant marker
+            "ts": round(self.now_us(), 3),
+            "pid": self._pid,
+            "tid": self._tid(),
+        }
+        if args:
+            event["args"] = args
+        with self._lock:
+            self._events.append(event)
+
+    def events(self) -> list[dict]:
+        with self._lock:
+            return list(self._events)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._events.clear()
+
+    def dump(self, path: str, reason: str = "", extra: dict | None = None) -> str:
+        """Write the ring as a Perfetto-loadable trace file (atomic tmp +
+        replace; the ring is NOT cleared — later incidents still carry the
+        shared history)."""
+        payload = {
+            "traceEvents": self.events(),
+            "displayTimeUnit": "ms",
+            "otherData": {
+                "reason": reason,
+                "pid": self._pid,
+                "capacity": self.capacity,
+                **(extra or {}),
+            },
+        }
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        tmp = f"{path}.{self._pid}.tmp"
+        with open(tmp, "w", encoding="utf-8") as fh:
+            json.dump(payload, fh)
+        os.replace(tmp, path)
+        self.dumped += 1
+        return path
+
+
+#: process-wide recorder every span records into
+RECORDER = FlightRecorder()
+
+
+class _Span:
+    __slots__ = ("name", "args", "_t0")
+
+    def __init__(self, name: str, args: dict | None):
+        self.name = name
+        self.args = args or None
+
+    def __enter__(self):
+        self._t0 = RECORDER.now_us()
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        args = self.args
+        if exc_type is not None:
+            args = dict(args or {})
+            args["error"] = exc_type.__name__
+        RECORDER.add_complete(self.name, self._t0, RECORDER.now_us() - self._t0, args)
+        return False
+
+
+class _NullSpan:
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        return False
+
+
+_NULL_SPAN = _NullSpan()
+
+
+def span(name: str, **args):
+    """Context manager recording one complete trace event; the shared
+    no-op object when tracing is disabled (one branch, no allocation)."""
+    if not _ENABLED:
+        return _NULL_SPAN
+    return _Span(name, args or None)
+
+
+def instant(name: str, **args) -> None:
+    """Record an instant marker (fault injected, rollback, drain)."""
+    if _ENABLED:
+        RECORDER.add_instant(name, args or None)
+
+
+def dump_flight_record(
+    run_dir: str, reason: str, step: int | None = None, extra: dict | None = None
+) -> str | None:
+    """Dump the flight recorder into ``run_dir`` as
+    ``flight_<reason>[_stepN].json``; best-effort (an incident dump must
+    never mask the incident), returns the path or None."""
+    if not _ENABLED:
+        return None
+    tag = reason.replace(" ", "_").replace("/", "_")
+    name = f"flight_{tag}" + (f"_step{step}" if step is not None else "") + ".json"
+    path = os.path.join(run_dir, name)
+    try:
+        info = {"step": step, **(extra or {})} if step is not None or extra else extra
+        return RECORDER.dump(path, reason=reason, extra=info)
+    except OSError:
+        return None
+
+
+# -- unclean-exit arming -------------------------------------------------------
+
+_exit_hooks: dict[int, tuple] = {}
+_exit_lock = threading.Lock()
+_exit_registered = False
+_hook_seq = 0
+
+
+def _run_exit_hooks() -> None:
+    with _exit_lock:
+        hooks = list(_exit_hooks.values())
+        _exit_hooks.clear()
+    for run_dir, step_fn in hooks:
+        try:
+            dump_flight_record(
+                run_dir, "unclean_exit", step=step_fn() if step_fn else None
+            )
+        except Exception:
+            pass
+
+
+def arm_exit_dump(run_dir: str, step_fn=None):
+    """Arm an ``atexit`` flight-record dump for an in-flight session: if the
+    process exits while armed (sys.exit, un-handled exception past the
+    session, interpreter teardown after SIGTERM default handling), the ring
+    is dumped into ``run_dir`` with reason ``unclean_exit``.  Returns a
+    disarm callable — the session's CLEAN exit path calls it, so normal
+    completions leave no incident file."""
+    global _exit_registered, _hook_seq
+    with _exit_lock:
+        if not _exit_registered:
+            import atexit
+
+            atexit.register(_run_exit_hooks)
+            _exit_registered = True
+        _hook_seq += 1
+        token = _hook_seq
+        _exit_hooks[token] = (run_dir, step_fn)
+
+    def disarm() -> None:
+        with _exit_lock:
+            _exit_hooks.pop(token, None)
+
+    return disarm

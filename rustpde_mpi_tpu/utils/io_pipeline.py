@@ -61,6 +61,8 @@ import threading
 import time as _time
 from collections import deque
 
+from ..telemetry import metrics as _tm
+
 
 class AsyncWriteError(RuntimeError):
     """A background checkpoint/snapshot write failed.
@@ -286,9 +288,20 @@ class AsyncCheckpointWriter:
                     with self._lock:
                         self._failed.append(ticket)
                 finally:
+                    write_s = _time.monotonic() - t0
                     with self._lock:
                         self.writes += 1
-                        self.write_s += _time.monotonic() - t0
+                        self.write_s += write_s
+                    _tm.counter(
+                        "io_writes_total", "background writes completed"
+                    ).inc()
+                    _tm.counter(
+                        "io_write_seconds_total", "worker seconds spent writing"
+                    ).inc(write_s)
+                    if ticket.error is not None:
+                        _tm.counter(
+                            "io_write_failures_total", "background writes that failed"
+                        ).inc()
                     ticket._event.set()
                     self._slots.release()
             finally:
@@ -329,7 +342,15 @@ class AsyncCheckpointWriter:
         t0 = _time.monotonic()
         if not self._slots.acquire(timeout=self.timeout_s):
             self._hang(f"back-pressure wait ({self.depth} writes in flight)", path)
-        self.wait_s += _time.monotonic() - t0
+        waited = _time.monotonic() - t0
+        self.wait_s += waited
+        _tm.counter(
+            "io_backpressure_seconds_total",
+            "submitter seconds blocked on the in-flight write window",
+        ).inc(waited)
+        _tm.counter("io_bytes_total", "payload bytes handed to the writer").inc(
+            int(nbytes)
+        )
         with self._lock:
             while self._inflight and self._inflight[0].done():
                 self._inflight.popleft()  # keep the deque bounded by depth+1

@@ -29,17 +29,24 @@ from . import checkpoint
 def _emit_info_line(model, t, vals, io_name: str, extra: str | None) -> None:
     """Print + persist one boundary's diagnostics (shared by the synchronous
     path and the pipeline's lagged emission)."""
-    nu, nuvol, re, div = (float(v) for v in vals)
+    nu, nuvol, re, div = (float(v) for v in vals[:4])
+    # an extended vocabulary (the passive-scalar sherwood) rides along by
+    # name behind the conventional four — index 3 stays the NaN detector
+    names = tuple(getattr(model, "observable_names", ()))[4:]
+    extras = [(name, float(v)) for name, v in zip(names, vals[4:])]
     # in-memory diagnostics map — the hook the reference allocates but never
     # fills (/root/reference/src/navier_stokes/navier.rs:81)
     diag = getattr(model, "diagnostics", None)
     if diag is not None:
-        for key, val in (("time", t), ("nu", nu), ("nuvol", nuvol), ("re", re), ("div", div)):
+        rows = [("time", t), ("nu", nu), ("nuvol", nuvol), ("re", re), ("div", div)]
+        for key, val in rows + extras:
             diag.setdefault(key, []).append(float(val))
     line = (
         f"time = {t:9.3f}      |div| = {div:4.2e}      "
         f"Nu = {nu:5.3e}      Nuv = {nuvol:5.3e}      Re = {re:5.3e}"
     )
+    for name, val in extras:
+        line += f"      {name.capitalize()} = {val:5.3e}"
     if extra:
         line += f"      {extra}"
     print(line)

@@ -203,27 +203,39 @@ def _jaxpr_dot_flops(jaxpr) -> float:
     return total
 
 
-def step_flops(model) -> float | None:
+def step_flops(model, method: str = "auto") -> float | None:
     """FLOPs of one time step: XLA cost analysis when the backend exposes it,
     else an exact jaxpr-level dot_general count (the axon relay exposes no
     cost analysis; the dot count is exact for this GEMM-dominated workload
     and tracks every fold/fusion the layout actually executes), else the
-    legacy analytic estimate."""
+    legacy analytic estimate.
+
+    ``method="jaxpr"`` skips the cost-analysis pass (which COMPILES a fresh
+    jit of the step) and goes straight to the trace-only dot count — the
+    cheap form the serve scheduler's live MFU gauge uses per campaign."""
     import jax
 
     example = None
-    try:
-        example = jax.tree.map(
-            lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), model.state
-        )
-        lowered = jax.jit(model._make_step()).lower(example)
-        cost = lowered.compile().cost_analysis()
-        if isinstance(cost, (list, tuple)):  # newer jaxlib: one dict per device
-            cost = cost[0] if cost else None
-        if cost and cost.get("flops"):
-            return float(cost["flops"])
-    except Exception:
-        pass
+    if method == "jaxpr":
+        try:
+            example = jax.tree.map(
+                lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), model.state
+            )
+        except Exception:
+            return _analytic_step_flops(model)
+    else:
+        try:
+            example = jax.tree.map(
+                lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), model.state
+            )
+            lowered = jax.jit(model._make_step()).lower(example)
+            cost = lowered.compile().cost_analysis()
+            if isinstance(cost, (list, tuple)):  # newer jaxlib: one dict per device
+                cost = cost[0] if cost else None
+            if cost and cost.get("flops"):
+                return float(cost["flops"])
+        except Exception:
+            pass
     try:
         closed = jax.make_jaxpr(model._make_step())(example)
         return _jaxpr_dot_flops(closed.jaxpr)
@@ -288,16 +300,25 @@ def _analytic_step_flops(model) -> float:
     return k * gemms * factor * 2.0 * n**3
 
 
+def peak_flops_key(platform: str | None = None) -> str:
+    """The :data:`PEAK_FLOPS` entry for a platform (default: the current
+    backend) — ONE mapping shared by :func:`mfu_estimate` and the serve
+    scheduler's live ``serve_mfu`` gauge, so a new platform/peak entry
+    cannot silently diverge between them."""
+    if platform is None:
+        try:
+            import jax
+
+            platform = jax.devices()[0].platform
+        except Exception:
+            platform = "cpu"
+    return "tpu_v5e_f32" if platform in ("tpu", "axon") else "cpu"
+
+
 def mfu_estimate(model, steps_per_sec: float) -> dict:
     """Model-flops-utilization estimate: step FLOPs x rate / peak."""
-    import jax
-
     flops = step_flops(model)
-    platform = jax.devices()[0].platform
-    if platform in ("tpu", "axon"):
-        key = "tpu_v5e_f32"
-    else:
-        key = "cpu"
+    key = peak_flops_key()
     peak = PEAK_FLOPS[key]
     return {
         "flops_per_step": flops,

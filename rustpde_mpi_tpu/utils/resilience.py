@@ -71,6 +71,9 @@ import time as _time
 
 import numpy as np
 
+from ..telemetry import metrics as _tm
+from ..telemetry import tracing as _tr
+from ..telemetry.exporters import MetricsDumper
 from . import checkpoint
 from .faults import FaultPlan, FaultSpecError, validate_fault_env  # noqa: F401
 from .governor import StabilityGovernor
@@ -354,6 +357,15 @@ class ResilientRunner:
         self._journal_writer: JournalWriter | None = None
         self._journal_owned = True  # close on teardown unless set_journal'd
 
+        # live telemetry (rustpde_mpi_tpu/telemetry): the SLO throughput
+        # baseline journaling `perf_degraded` (replaceable — tests inject a
+        # fake clock), the cadenced metrics.jsonl dumper (armed per session,
+        # root only) and the flight-recorder exit hook disarm callable
+        self.slo = _tm.ThroughputMonitor()
+        self._slo_last_step = 0
+        self._metrics_dumper: MetricsDumper | None = None
+        self._exit_disarm = None
+
         self.step = 0  # global step counter (survives resume via ckpt attrs)
         self.attempt = 0  # divergence retries so far
         self.resumed = False  # set by session(): a checkpoint was restored
@@ -545,12 +557,17 @@ class ResilientRunner:
         self._last_ckpt_wall = _time.monotonic()
         self._last_ckpt_time = float(self.pde.get_time())
         self._last_ckpt_path = path
+        write_s = _time.monotonic() - t0
+        _tm.histogram(
+            "checkpoint_write_seconds", "serialize+digest+fsync seconds"
+        ).observe(write_s)
+        _tm.counter("checkpoints_total", "checkpoints written", reason=reason).inc()
         self._journal(
             {
                 "event": "checkpoint",
                 "reason": reason,
                 "path": path,
-                "write_s": round(_time.monotonic() - t0, 3),
+                "write_s": round(write_s, 3),
                 "nu": self._nu(),
             }
         )
@@ -566,12 +583,16 @@ class ResilientRunner:
         drains the writer first, so recovery can never target a file that
         is still being written."""
         t0 = _time.monotonic()
-        if self._is_ensemble:
-            snap = checkpoint.ensemble_snapshot_to_host(self.pde, step=self.step)
-        else:
-            snap = checkpoint.snapshot_to_host(self.pde, step=self.step)
+        with _tr.span("checkpoint_stage", reason=reason, step=self.step):
+            if self._is_ensemble:
+                snap = checkpoint.ensemble_snapshot_to_host(self.pde, step=self.step)
+            else:
+                snap = checkpoint.snapshot_to_host(self.pde, step=self.step)
         snapshot_s = _time.monotonic() - t0
         self._io_snapshot_s += snapshot_s
+        _tm.histogram(
+            "checkpoint_snapshot_seconds", "main-thread device->host staging"
+        ).observe(snapshot_s)
         event = {
             "event": "checkpoint",
             "reason": reason,
@@ -600,7 +621,14 @@ class ResilientRunner:
                 raise
             with self._lock:
                 self._last_ckpt_path = path
-            self._journal({**event, "write_s": round(_time.monotonic() - w0, 3)})
+            write_s = _time.monotonic() - w0
+            _tm.histogram(
+                "checkpoint_write_seconds", "serialize+digest+fsync seconds"
+            ).observe(write_s)
+            _tm.counter(
+                "checkpoints_total", "checkpoints written", reason=reason
+            ).inc()
+            self._journal({**event, "write_s": round(write_s, 3)})
 
         self._io.submit_write(work, path, nbytes=snap.nbytes)
         # cadence clocks restart at SUBMIT time: the snapshot point is what
@@ -626,9 +654,13 @@ class ResilientRunner:
         checkpoints (anchor/final/preempt) write and commit inline."""
         self._commit_pending()  # at most one deferred commit in flight
         t0 = _time.monotonic()
-        snap = checkpoint.sharded_snapshot_to_host(self.pde, step=self.step)
+        with _tr.span("checkpoint_stage", reason=reason, step=self.step):
+            snap = checkpoint.sharded_snapshot_to_host(self.pde, step=self.step)
         snapshot_s = _time.monotonic() - t0
         self._io_snapshot_s += snapshot_s
+        _tm.histogram(
+            "checkpoint_snapshot_seconds", "main-thread device->host staging"
+        ).observe(snapshot_s)
         event = {
             "event": "checkpoint",
             "reason": reason,
@@ -697,7 +729,12 @@ class ResilientRunner:
         manifest), rotate on success, journal the ``checkpoint_sharded``
         telemetry (shard count, bytes/host, barrier wait seconds)."""
         w0 = _time.monotonic()
-        stats = checkpoint.commit_sharded_snapshot(snap, path, local_ok=local_ok)
+        with _tr.span("checkpoint_commit", step=self.step):
+            stats = checkpoint.commit_sharded_snapshot(snap, path, local_ok=local_ok)
+        _tm.counter(
+            "checkpoint_barrier_seconds_total",
+            "seconds waiting at the two-phase commit barrier",
+        ).inc(float(stats.get("barrier_s") or 0.0))
         if not stats["ok"]:
             if local_ok:
                 # the failing host already journaled its local cause; only
@@ -719,6 +756,7 @@ class ResilientRunner:
             )
         if _is_root():
             checkpoint.rotate_checkpoints(self.run_dir, self.keep)
+        _tm.counter("checkpoints_total", "checkpoints written", reason=reason).inc()
         with self._lock:
             self._last_ckpt_path = path
         self._last_ckpt_wall = _time.monotonic()
@@ -829,9 +867,10 @@ class ResilientRunner:
                     jax.block_until_ready(state)
             return result
 
-        return call_with_watchdog(
-            work, self.dispatch_timeout_s, label=f"update_n({n}) @ step {self.step}"
-        )
+        with _tr.span("dispatch", steps=n, step=self.step):
+            return call_with_watchdog(
+                work, self.dispatch_timeout_s, label=f"update_n({n}) @ step {self.step}"
+            )
 
     def _advance(self, pde, n: int) -> None:
         """Advance n steps in sub-chunks of at most ``max_chunk_steps``, so
@@ -863,6 +902,7 @@ class ResilientRunner:
                 if committed:
                     self.step += k
                     n -= k
+                    _tm.counter("runner_steps_total", "committed simulation steps").inc(k)
                 if not committed or pde.get_dt() != dt_before:
                     # rolled back (retry at the governor's new dt) or dt
                     # adjusted: the remaining step budget was planned at the
@@ -875,6 +915,7 @@ class ResilientRunner:
             else:
                 self.step += k
                 n -= k
+                _tm.counter("runner_steps_total", "committed simulation steps").inc(k)
             if n > 0 and self._root_decides(self._interrupt is not None):
                 return  # integrate()'s on_chunk acts at the boundary
 
@@ -913,6 +954,7 @@ class ResilientRunner:
                 committed = self._govern(pde, status)
                 if committed:
                     self.step += kprev
+                    _tm.counter("runner_steps_total", "committed simulation steps").inc(kprev)
                 if not committed:
                     # chunk kprev rolled back in memory (retry/kill/giveup):
                     # the speculative chunk stepped a doomed state — drop it
@@ -929,6 +971,9 @@ class ResilientRunner:
                         status2 = self._resolve_pending(chunk2, k2)
                         if self._govern(pde, status2):
                             self.step += k2
+                            _tm.counter(
+                                "runner_steps_total", "committed simulation steps"
+                            ).inc(k2)
                     return
             pending = nxt
             if (
@@ -949,20 +994,22 @@ class ResilientRunner:
                 _time.sleep(max(2.0 * (self.dispatch_timeout_s or 0.0), 1.0))
             return pde.update_n_pending(k)
 
-        return call_with_watchdog(
-            work,
-            self.dispatch_timeout_s,
-            label=f"update_n_pending({k}) @ step {self.step}",
-        )
+        with _tr.span("dispatch_pending", steps=k, step=self.step):
+            return call_with_watchdog(
+                work,
+                self.dispatch_timeout_s,
+                label=f"update_n_pending({k}) @ step {self.step}",
+            )
 
     def _resolve_pending(self, chunk, k: int):
         """Watchdog-guarded resolve: a wedged device materializes here, at
         the sentinel fetch, instead of at the dispatch."""
-        return call_with_watchdog(
-            chunk.resolve,
-            self.dispatch_timeout_s,
-            label=f"resolve({k}) @ step {self.step}",
-        )
+        with _tr.span("resolve", steps=k, step=self.step):
+            return call_with_watchdog(
+                chunk.resolve,
+                self.dispatch_timeout_s,
+                label=f"resolve({k}) @ step {self.step}",
+            )
 
     def _govern(self, pde, status) -> bool:
         """Feed one chunk's sentinel status through the governor and apply
@@ -970,6 +1017,15 @@ class ResilientRunner:
         advanced), False when it was rolled back in memory."""
         gov = self.governor
         decision = gov.on_chunk(status, step=self.step)
+        # live governor gauges: the host-side sentinel scalars the chunk
+        # already fetched — never an extra device transfer
+        _tm.gauge("governor_cfl", "chunk-max advective CFL").set(status.cfl_max)
+        _tm.gauge("governor_rung", "dt-ladder rung index").set(gov.rung)
+        _tm.gauge("governor_dt", "current governed dt").set(status.dt)
+        if status.pre_divergence:
+            _tm.counter(
+                "runner_pre_divergence_total", "CFL-ceiling sentinel catches"
+            ).inc()
         self._journal(
             {
                 "event": "cfl",
@@ -994,6 +1050,7 @@ class ResilientRunner:
             )
             if decision.action == "retry":
                 pde.set_dt(decision.dt)
+                _tm.counter("runner_dt_adjust_total", "governor dt changes").inc()
                 self._journal(
                     {
                         "event": "dt_adjust",
@@ -1027,6 +1084,7 @@ class ResilientRunner:
             return False
         if decision.action == "adjust":
             pde.set_dt(decision.dt)
+            _tm.counter("runner_dt_adjust_total", "governor dt changes").inc()
             self._journal(
                 {
                     "event": "dt_adjust",
@@ -1050,6 +1108,7 @@ class ResilientRunner:
             if self.step != fault.step:
                 return  # pre-advance stopped early (signal); fire later
             fault.fired = True
+            _tr.instant("fault_injected", kind=fault.kind, step=self.step)
             self._journal(
                 {"event": "fault_injected", "kind": fault.kind, "host": fault.host}
             )
@@ -1085,6 +1144,21 @@ class ResilientRunner:
         # is here together) — this is where the overlapped shard write
         # rejoins the two-phase protocol, one chunk after its submit
         self._commit_pending()
+        # boundary telemetry: feed the SLO throughput baseline the steps
+        # committed since the previous boundary (host-side counters only);
+        # a regression below the rolling baseline journals the typed
+        # perf_degraded event — observability feeding back into robustness
+        delta = self.step - self._slo_last_step
+        self._slo_last_step = self.step
+        degraded = self.slo.record(delta)
+        if degraded is not None:
+            _tm.counter(
+                "runner_perf_degraded_total", "SLO throughput regressions"
+            ).inc()
+            _tr.instant("perf_degraded", **degraded)
+            self._journal({"event": "perf_degraded", **degraded})
+        if self._metrics_dumper is not None:
+            self._metrics_dumper.maybe_dump(step=self.step)
         if self._preempt_agreed():
             return True  # integrate() returns "stopped"; run() checkpoints
         due = False
@@ -1132,6 +1206,10 @@ class ResilientRunner:
         return traj
 
     def _rollback(self) -> None:
+        _tm.counter(
+            "runner_rollbacks_total", "reactive checkpoint rollbacks"
+        ).inc()
+        _tr.instant("rollback", step=self.step, attempt=self.attempt)
         path = self._pick_checkpoint()
         if path is None:
             raise DivergenceError(
@@ -1198,6 +1276,15 @@ class ResilientRunner:
         if install_signals:
             self._install_signals()
         self._setup_io()
+        # telemetry arming (root only: run_dir is shared on multihost):
+        # cadenced metrics.jsonl for headless runs + the unclean-exit
+        # flight-record hook — disarmed on ANY session exit below (the
+        # exception paths dump explicitly, with a better reason)
+        if _is_root():
+            self._metrics_dumper = MetricsDumper(
+                os.path.join(self.run_dir, "metrics.jsonl")
+            )
+            self._exit_disarm = _tr.arm_exit_dump(self.run_dir, lambda: self.step)
         try:
             if self.resume if resume is None else resume:
                 self.resumed = self._maybe_resume()
@@ -1211,11 +1298,38 @@ class ResilientRunner:
             # _teardown_io stays safe)
             if self._io is not None:
                 self._io.abandon_diags()
+            self.incident_dump("dispatch_hang")
+            raise
+        except BaseException as exc:
+            # every incident ships with a timeline: DivergenceError, write
+            # failures, KeyboardInterrupt — dumped before teardown so the
+            # ring still holds the events leading in
+            self.incident_dump(type(exc).__name__)
             raise
         finally:
+            if self._exit_disarm is not None:
+                self._exit_disarm()
+                self._exit_disarm = None
             self._teardown_io()
             if install_signals:
                 self._restore_signals()
+
+    def incident_dump(self, reason: str) -> None:
+        """Best-effort flight-recorder dump into the run_dir (root only) +
+        a journal pointer — incident telemetry must never mask the
+        incident itself.  Public: part of the embedding surface (the serve
+        scheduler dumps with reason ``drain``), also driven internally on
+        every exception escaping a session and on preemption."""
+        if not _is_root():
+            return
+        try:
+            path = _tr.dump_flight_record(self.run_dir, reason, step=self.step)
+            if path is not None:
+                self._journal(
+                    {"event": "flight_record", "reason": reason, "path": path}
+                )
+        except Exception:
+            pass
 
     # -- the embedding surface (serve.SimServer) ------------------------------
 
@@ -1318,6 +1432,8 @@ class ResilientRunner:
                     self._drain_io()
                     self._journal_health()
                     self._journal({"event": "preempted", "signal": self._interrupt})
+                    # a preemption IS an incident: ship the timeline with it
+                    self.incident_dump("preempt")
                     return self._summary("preempted")
                 # status == "break": the model's NaN criterion fired (or a
                 # sentinel catch the governor gave up on)
@@ -1394,6 +1510,10 @@ class ResilientRunner:
                     "diag_lag": self.io.diag_lag,
                 }
             )
+        if self._metrics_dumper is not None:
+            # run-end flush: headless campaigns always leave at least one
+            # metrics.jsonl line next to the journal
+            self._metrics_dumper.dump(step=self.step)
 
     def _teardown_io(self) -> None:
         """run() exit: settle the pipeline WITHOUT masking an in-flight

@@ -37,6 +37,7 @@ def main() -> int:
     if not args.fast:
         env["RUSTPDE_SLOW"] = "1"
     tier = "fast" if args.fast else "full (RUSTPDE_SLOW=1)"
+    tier_key = "fast" if args.fast else "full"
     budget_s = float(os.environ.get("RUSTPDE_TEST_BUDGET_S", "45"))
     timeout_s = 7200
     t0 = time.time()
@@ -66,7 +67,7 @@ def main() -> int:
             "returncode": 124,
             "date": _utc_now(),
         }
-        _persist(record)
+        _persist(record, tier_key)
         print(json.dumps(record))
         sys.stderr.write((out or "")[-4000:])
         return 124
@@ -106,9 +107,13 @@ def main() -> int:
         # recorded into PARITY.json too, so cross-model vmap/scan drift
         # shows up per-PR next to the Nu-parity numbers
         "workloads": _workloads_parity(),
+        # telemetry inventory (METRICS.json written alongside): the metric
+        # names an instrumented run registers — a per-PR record of the
+        # observable vocabulary, like the journal schema rows
+        "metrics": _metrics_snapshot(),
         "date": _utc_now(),
     }
-    _persist(record)
+    _persist(record, tier_key)
     print(json.dumps(record))
     if proc.returncode != 0:
         sys.stderr.write(proc.stdout[-4000:])
@@ -226,23 +231,100 @@ def _workloads_parity() -> dict | None:
     return payload
 
 
+_METRICS_CHILD = r"""
+import json, os, sys, tempfile
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.setdefault("RUSTPDE_X64", "1")
+sys.path.insert(0, %(repo)r)
+import jax
+jax.config.update("jax_platforms", "cpu")
+from rustpde_mpi_tpu import Navier2D, ResilientRunner, telemetry
+from rustpde_mpi_tpu.config import StabilityConfig
+
+d = tempfile.mkdtemp()
+m = Navier2D(17, 17, 1e4, 1.0, 0.01, 1.0, "rbc", periodic=False)
+m.init_random(0.1, seed=0)
+r = ResilientRunner(m, max_time=0.08, run_dir=os.path.join(d, "run"),
+                    checkpoint_every_s=None, max_chunk_steps=4,
+                    stability=StabilityConfig())
+r.run()
+print("METRICS_JSON " + json.dumps(telemetry.snapshot()))
+"""
+
+
+def _metrics_snapshot() -> dict | None:
+    """Snapshot the telemetry registry of a tiny instrumented governed run
+    (CPU child) into METRICS.json next to TESTS.json — the per-PR record
+    of the live metric vocabulary (names, kinds, label sets), like the
+    journal schema table but machine-readable.  Best-effort: a failure
+    records the error string instead of killing the test record."""
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", _METRICS_CHILD % {"repo": _REPO}],
+            capture_output=True,
+            text=True,
+            timeout=600,
+            cwd=_REPO,
+        )
+        line = next(
+            ln for ln in proc.stdout.splitlines()
+            if ln.startswith("METRICS_JSON ")
+        )
+        snap = json.loads(line[len("METRICS_JSON "):])
+    except Exception as exc:  # noqa: BLE001 — recording must not fail the run
+        return {"error": f"{type(exc).__name__}: {exc}"}
+    payload = {
+        "names": {
+            name: fam.get("kind", "?") for name, fam in sorted(snap.items())
+        },
+        "snapshot": snap,
+        "date": _utc_now(),
+    }
+    path = os.path.join(_REPO, "METRICS.json")
+    tmp = f"{path}.{os.getpid()}.tmp"
+    with open(tmp, "w") as f:
+        json.dump(payload, f, indent=1)
+    os.replace(tmp, path)
+    # the TESTS.json row carries the compact inventory, not the full dump
+    return {"names": payload["names"], "date": payload["date"]}
+
+
 def _utc_now() -> str:
     return datetime.datetime.now(datetime.timezone.utc).strftime(
         "%Y-%m-%d %H:%M UTC"
     )
 
 
-def _persist(record: dict) -> None:
-    """Append ``record`` to TESTS.json (latest + last-10 history)."""
-    prev = []
+def _persist(record: dict, tier_key: str) -> None:
+    """Append ``record`` to TESTS.json, keeping SEPARATE fast-tier and
+    full-tier sections (``{"fast": {latest, history}, "full": {...}}``): a
+    stale full-tier ``latest`` used to shadow every later fast-tier run,
+    so a tier-1 regression was invisible in the record.  The legacy
+    top-level ``latest`` stays as "most recent run of any tier" for old
+    readers; legacy flat histories are migrated by their tier string."""
     path = os.path.join(_REPO, "TESTS.json")
     try:
         with open(path) as f:
-            prev = json.load(f).get("history", [])
+            prev = json.load(f)
     except (OSError, ValueError):
-        pass
+        prev = {}
+    tiers = {}
+    for key in ("fast", "full"):
+        section = prev.get(key)
+        tiers[key] = dict(section) if isinstance(section, dict) else {}
+        tiers[key].setdefault("history", [])
+    # one-time migration of the legacy flat history (entries carry a human
+    # tier string: "fast" or "full (RUSTPDE_SLOW=1)")
+    for entry in prev.get("history", []):
+        key = "fast" if str(entry.get("tier", "")).startswith("fast") else "full"
+        if entry not in tiers[key]["history"]:
+            tiers[key]["history"].append(entry)
+    tiers[tier_key]["latest"] = record
+    tiers[tier_key]["history"] = (tiers[tier_key]["history"] + [record])[-10:]
+    for key in ("fast", "full"):
+        tiers[key].setdefault("latest", None)
     with open(path, "w") as f:
-        json.dump({"latest": record, "history": (prev + [record])[-10:]}, f, indent=1)
+        json.dump({"latest": record, **tiers}, f, indent=1)
 
 
 if __name__ == "__main__":

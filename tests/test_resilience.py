@@ -261,7 +261,10 @@ def test_retries_exhausted_raises(tmp_path):
         runner.run()
     events = [e["event"] for e in _events(run_dir)]
     assert events.count("divergence") == 2
-    assert events[-1] == "giveup"
+    # giveup is the terminal RUN event; the telemetry layer appends its
+    # flight_record pointer behind it as the session unwinds (PR 8)
+    assert [e for e in events if e != "flight_record"][-1] == "giveup"
+    assert events[-1] == "flight_record"
 
 
 def test_sigterm_checkpoints_then_resume_continues(tmp_path):
@@ -390,7 +393,10 @@ def test_slow_fault_trips_dispatch_watchdog(tmp_path):
     with pytest.raises(DispatchHang, match="update_n"):
         runner.run()
     events = [e["event"] for e in _events(run_dir)]
-    assert events[-1] == "dispatch_hang"
+    # dispatch_hang is the terminal RUN event; the flight-record pointer
+    # rides behind it as the session unwinds (PR 8)
+    assert [e for e in events if e != "flight_record"][-1] == "dispatch_hang"
+    assert events[-1] == "flight_record"
     assert "fault_injected" in events
 
 

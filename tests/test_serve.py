@@ -62,6 +62,28 @@ def _solo_nu(result):
     return float(m.eval_nu())
 
 
+def _parse_prometheus(text):
+    """Strict-enough parser for the exposition format: every line must be a
+    ``# HELP``/``# TYPE`` comment or ``name[{labels}] value``; returns
+    ``{name: {labels_str: (value,)}}`` and asserts the format en route."""
+    import re
+
+    samples = {}
+    line_re = re.compile(
+        r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{[^}]*\})? ([0-9eE+.\-]+|NaN|[+-]Inf)$"
+    )
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            if line:
+                assert line.startswith(("# HELP ", "# TYPE ")), line
+            continue
+        m = line_re.match(line)
+        assert m, f"unparseable exposition line: {line!r}"
+        name, labels, value = m.groups()
+        samples.setdefault(name, {})[labels or ""] = (float(value),)
+    return samples
+
+
 # -- requests + queue ---------------------------------------------------------
 
 
@@ -345,7 +367,17 @@ def test_serve_admission_and_http_front(tmp_path):
             except urllib.error.HTTPError as err:
                 return err.code, json.loads(err.read())
 
-        assert get("/healthz") == (200, {"ok": True, "draining": False})
+        def get_text(path):
+            with urllib.request.urlopen(base + path, timeout=30) as resp:
+                return resp.status, resp.read().decode("utf-8")
+
+        # enriched /healthz: liveness PLUS queue depth + slot utilization
+        code, health = get("/healthz")
+        assert code == 200
+        assert health["ok"] is True and health["draining"] is False
+        assert set(health["queue"]) == {"queued", "running", "done", "failed"}
+        assert set(health["slots"]) == {"running", "total", "utilization"}
+        assert health["slots"]["total"] == 2
         code, ack = post("/requests", dict(_REQ, seed=0))
         assert code == 202 and ack["steps"] == 10
         code, err = post("/requests", dict(_REQ, dt=-1.0))
@@ -360,13 +392,25 @@ def test_serve_admission_and_http_front(tmp_path):
                 rejected = body
                 break
         assert rejected is not None and rejected["reason"] == "queue_full"
+        # live /metrics scrape MID-SOAK: the daemon campaign is running the
+        # queued requests while this GET renders the registry (the ISSUE's
+        # acceptance criterion) — Prometheus-parseable, serve series present
+        code, text = get_text("/metrics")
+        assert code == 200
+        samples = _parse_prometheus(text)
+        assert "serve_queue_depth" in samples
+        assert "serve_requests_admitted_total" in samples
+        assert any(s[0] >= 1 for s in samples["http_requests_total"].values())
         code, status = get(f"/requests/{ack['id']}")
         assert code == 200 and status["state"] in ("queued", "running", "done")
         assert get("/requests/unknown-id")[0] == 404
         code, stats = get("/stats")
-        assert code == 200 and "queue" in stats
+        assert code == 200 and "queue" in stats and "slots" in stats
         code, body = post("/drain", {})
         assert code == 202 and body["draining"] is True
+        # concurrent submits during the drain: typed 429 with the reason
+        code, body = post("/requests", dict(_REQ, seed=99))
+        assert code == 429 and body["reason"] == "draining"
     finally:
         srv.request_drain()
         thread.join(timeout=300)
@@ -377,6 +421,77 @@ def test_serve_admission_and_http_front(tmp_path):
     counts = srv.queue.counts()
     assert counts["running"] == 0
     assert counts["done"] + counts["queued"] + counts["failed"] >= 2
+
+
+def test_http_front_error_paths(tmp_path):
+    """Broken HTTP frames must map to typed statuses, not tracebacks or
+    hangs: non-integer / negative Content-Length -> 400, an oversized body
+    -> 413 (rejected BEFORE reading), a truncated body (client hung up
+    mid-send) -> 400 — and the front serves /metrics + enriched /healthz
+    standalone (it only touches the scheduler's thread-safe surface)."""
+    import socket
+
+    from rustpde_mpi_tpu.serve.http_front import HttpFront
+
+    srv = SimServer(_cfg(tmp_path))
+    front = HttpFront(srv)
+    front.start()
+    try:
+        host, port = front.address
+
+        def raw(request: bytes) -> str:
+            # send, then half-close the write side: the server sees EOF on
+            # any body read it attempts (the hung-up-client shape), while
+            # the read side stays open for the response
+            with socket.create_connection((host, port), timeout=30) as sock:
+                sock.sendall(request)
+                sock.shutdown(socket.SHUT_WR)
+                sock.settimeout(30)
+                chunks = []
+                while True:
+                    data = sock.recv(65536)
+                    if not data:
+                        break
+                    chunks.append(data)
+            return b"".join(chunks).decode("utf-8", "replace")
+
+        def post_head(extra_headers: str, body: bytes = b"") -> str:
+            return raw(
+                (
+                    "POST /requests HTTP/1.1\r\n"
+                    f"Host: {host}\r\nConnection: close\r\n"
+                    f"{extra_headers}\r\n"
+                ).encode()
+                + body
+            )
+
+        # bad Content-Length: not an integer
+        resp = post_head("Content-Length: nope\r\n")
+        assert " 400 " in resp.splitlines()[0], resp.splitlines()[0]
+        assert "Content-Length" in resp
+        # negative Content-Length
+        resp = post_head("Content-Length: -5\r\n")
+        assert " 400 " in resp.splitlines()[0], resp.splitlines()[0]
+        # oversized body: rejected by the declared length, nothing read
+        resp = post_head(f"Content-Length: {(1 << 20) + 1}\r\n")
+        assert " 413 " in resp.splitlines()[0], resp.splitlines()[0]
+        # truncated body: client promises 100 bytes, sends 12, hangs up
+        resp = post_head("Content-Length: 100\r\n", body=b'{"ra": 1e4, ')
+        assert " 400 " in resp.splitlines()[0], resp.splitlines()[0]
+        assert "truncated" in resp
+        # nothing malformed was admitted
+        assert srv.queue.counts()["queued"] == 0
+        # standalone /metrics + /healthz (no campaign running)
+        base = f"http://{host}:{port}"
+        with urllib.request.urlopen(base + "/metrics", timeout=30) as resp:
+            assert resp.status == 200
+            assert resp.headers["Content-Type"].startswith("text/plain")
+            _parse_prometheus(resp.read().decode("utf-8"))
+        with urllib.request.urlopen(base + "/healthz", timeout=30) as resp:
+            health = json.loads(resp.read())
+        assert health["ok"] is True and health["slots"]["running"] == 0
+    finally:
+        front.stop()
 
 
 def test_serve_sigterm_drain_checkpoint_restart_resumes(tmp_path):
@@ -558,6 +673,27 @@ def test_serve_mixed_model_campaign(tmp_path):
             dict(_REQ, scenario={"passive_scalar": True, "scalar_kappa": 0.0})
         )
     assert srv.queue.counts()["queued"] == 0  # nothing poisonous persisted
+
+
+def test_serve_passive_scalar_surfaces_sherwood(tmp_path):
+    """The scalar observable vocabulary rides the serve path end-to-end: a
+    passive-scalar request's done record carries ``sherwood`` next to the
+    conventional four (streamed through the same observable futures), and
+    a plain DNS record does not."""
+    srv = SimServer(_cfg(tmp_path, slots=2))
+    scal = srv.submit(
+        dict(_REQ, seed=0, scenario={"passive_scalar": True})
+    ).id
+    plain = srv.submit(dict(_REQ, seed=0)).id
+    summary = srv.serve()
+    assert summary["completed"] == 2 and summary["failed"] == 0
+    res = srv.result(scal)
+    assert res["steps"] == 10
+    import math
+
+    assert math.isfinite(res["sherwood"])
+    assert {"nu", "nuvol", "re", "div", "sherwood"} <= set(res)
+    assert "sherwood" not in srv.result(plain)
 
 
 def test_serve_bucket_fairness_no_starvation(tmp_path):

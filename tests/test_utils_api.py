@@ -104,3 +104,37 @@ def test_transfer_function_limits():
     mask = bc_zero_values(x, x, k=50.0)
     assert mask.shape == (201, 201)
     assert abs(mask[0, 0] - 0.5) < 1e-6  # bottom plate value
+
+
+def test_telemetry_api_exports():
+    """The telemetry subsystem's public surface (API pin): the package
+    root carries the module + the two classes other layers hand around,
+    and the telemetry package itself exports the full documented set."""
+    import rustpde_mpi_tpu as rp
+
+    for name in ("telemetry", "MetricsRegistry", "ThroughputMonitor"):
+        assert hasattr(rp, name), name
+    for name in (
+        "REGISTRY",
+        "RECORDER",
+        "counter",
+        "gauge",
+        "histogram",
+        "snapshot",
+        "span",
+        "instant",
+        "prometheus_text",
+        "PROMETHEUS_CONTENT_TYPE",
+        "MetricsDumper",
+        "read_metrics_jsonl",
+        "FlightRecorder",
+        "dump_flight_record",
+        "arm_exit_dump",
+        "gather_global_snapshot",
+        "merge_snapshots",
+        "set_enabled",
+        "enabled",
+    ):
+        assert hasattr(rp.telemetry, name), name
+    # the default registry is ONE process-wide object shared by every layer
+    assert rp.telemetry.default_registry() is rp.telemetry.REGISTRY

@@ -119,6 +119,14 @@ def test_passive_scalar_mirrors_temperature_exactly():
     np.testing.assert_allclose(c, t, atol=1e-13)
     # and the scalar leaf rides snapshots (gathered layout)
     assert ("scal", "scal") in m.snapshot_vars
+    # the Sherwood observable (scalar-transfer analog of the plate-flux
+    # Nu) joins the vocabulary AFTER the conventional four — |div| stays
+    # the index-3 NaN detector — and the mirror identity transfers:
+    # matched diffusivity + equal release => sherwood == nu to fp noise
+    assert m.observable_names == ("nu", "nuvol", "re", "div", "sherwood")
+    obs = m.get_observables()
+    assert len(obs) == 5
+    assert obs[4] == pytest.approx(obs[0], rel=1e-11)
 
 
 def test_passive_scalar_with_distinct_kappa_diverges_from_temp():
