@@ -308,6 +308,15 @@ class ServeConfig:
     http_host: str = "127.0.0.1"
     http_port: int | None = None
     resilience: ResilienceConfig | None = None
+    # governed campaign dt (None = reactive-only): arms the on-device
+    # stability sentinels on every campaign ensemble and gives each bucket
+    # a per-bucket DtLadder — a CFL-ceiling catch re-buckets the pinned
+    # requests at a lower rung (requeue-WITH-state, journaled
+    # `bucket_dt_adjust`) instead of waiting for NaN + reactive retry.
+    # The batch-wide StabilityGovernor stays OFF in campaigns: per-request
+    # dt is part of the request contract and the bucket key, so the only
+    # legal dt response is re-bucketing, never an in-place set_dt.
+    stability: StabilityConfig | None = None
 
 
 @dataclass

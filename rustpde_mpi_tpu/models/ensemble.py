@@ -546,6 +546,13 @@ class NavierEnsemble(Integrate):
         """Acknowledge a ``pre_divergence`` catch (governor handled it)."""
         self._pre_div_latch = False
 
+    @property
+    def pre_divergence_latched(self) -> bool:
+        """True while an unacknowledged sentinel catch latches ``exit()`` —
+        the public form the serve scheduler's per-bucket dt governor reads
+        (``last_chunk_status.pinned`` names the tripping members)."""
+        return bool(self._pre_div_latch)
+
     def mark_dead(self, members) -> None:
         """Declare members dead (persistently CFL-pinned, governor decision):
         they freeze like diverged members and become ``respawn_dead``

@@ -27,6 +27,7 @@ exactly as the reference needs ``mpirun``.
 
 from __future__ import annotations
 
+import json
 import os
 
 import jax
@@ -183,15 +184,84 @@ def allgather_host(value) -> np.ndarray:
 
 def broadcast(value, is_source: bool | None = None):
     """Root-decides broadcast of a small host value (the preemption/rollback
-    handshake in utils/resilience.py: rank 0 decides, every host acts on the
-    same decision).  Identity on a single host; returns a numpy value."""
+    handshake in utils/resilience.py and every serve-scheduler decision:
+    rank 0 decides, every host acts on the same decision).  Identity on a
+    single host; returns a numpy value.
+
+    Like :func:`sync_hosts`, honors ``RUSTPDE_SYNC_TIMEOUT_S``: a peer that
+    died while this host is blocked inside the collective would otherwise
+    wedge the job forever — the root-coordinated scheduler runs several
+    broadcasts per boundary, most of them outside any dispatch watchdog, so
+    the structured-exit contract (journaled error stop, requests recovered
+    on restart) needs the timeout here too."""
     if jax.process_count() == 1:
         return np.asarray(value)
     from jax.experimental import multihost_utils
 
-    return multihost_utils.broadcast_one_to_all(
-        np.asarray(value), is_source=is_source
-    )
+    def run():
+        return multihost_utils.broadcast_one_to_all(
+            np.asarray(value), is_source=is_source
+        )
+
+    timeout = float(os.environ.get("RUSTPDE_SYNC_TIMEOUT_S", "0") or 0.0)
+    if timeout <= 0:
+        return run()
+    from ..utils.resilience import call_with_watchdog
+
+    return call_with_watchdog(run, timeout, label="broadcast")
+
+
+def broadcast_obj(obj=None):
+    """Root-decides broadcast of an arbitrary JSON-able host object (the
+    serve scheduler's per-boundary decision plans: bucket keys, slot
+    claim/refill assignments, retry/requeue verdicts).  Non-root callers
+    pass anything (ignored); every host returns root's object.  Two
+    broadcasts ride underneath — a length, then a padded byte buffer —
+    because ``broadcast_one_to_all`` needs an identical shape on every
+    host.  Identity on a single host.
+
+    JSON round-trips tuples into lists; callers holding tuple-shaped keys
+    re-tuple with :func:`tuplify`."""
+    import jax
+
+    if jax.process_count() == 1:
+        return obj
+    payload = b""
+    if is_root():
+        payload = json.dumps(obj).encode("utf-8")
+    n = int(broadcast(np.int64(len(payload))))
+    buf = np.zeros(n, dtype=np.uint8)
+    if is_root():
+        buf[:] = np.frombuffer(payload, dtype=np.uint8)
+    # the collective may widen the dtype (psum upcast): cast back before
+    # reinterpreting the element values as utf-8 bytes
+    data = np.asarray(broadcast(buf)).astype(np.uint8)
+    return json.loads(data.tobytes().decode("utf-8"))
+
+
+def root_decides(local: bool) -> bool:
+    """Root's verdict for a host flag that leads into a collective
+    (preemption/drain stops, cadence checkpoints, serve-loop exits): rank
+    0's value is broadcast so every host takes the same branch — hosts
+    evaluating signals or wall clocks locally would disagree and wedge the
+    next collective.  A stray local flag on a non-root host is therefore
+    deliberately IGNORED.  Single-host (or uninitialized runtime): the
+    local flag.  One copy of the primitive — the resilient runner and the
+    serve scheduler both ride it, so the handshake cannot drift."""
+    try:
+        if jax.process_count() == 1:
+            return bool(local)
+    except Exception:
+        return bool(local)
+    return bool(int(broadcast(np.int32(1 if local else 0))))
+
+
+def tuplify(obj):
+    """Recursively convert lists back to tuples (the inverse of the
+    tuple->list coercion a JSON round-trip applies to compat keys)."""
+    if isinstance(obj, list):
+        return tuple(tuplify(v) for v in obj)
+    return obj
 
 
 def sync_hosts(tag: str = "barrier") -> None:

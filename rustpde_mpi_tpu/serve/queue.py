@@ -10,7 +10,11 @@ One JSON file per request, one directory per lifecycle state::
 Every transition is ``os.replace`` of a file that was fsynced at admission
 — atomic on POSIX — so a crash at ANY point leaves each request in exactly
 one state: the durability story is the filesystem's rename atomicity, not
-a database.  Restart-time :meth:`recover` re-enqueues whatever was left in
+a database.  The parent lifecycle DIRECTORY is fsynced after each rename
+too: ``os.replace`` alone leaves the new directory entry in the page cache,
+so a power loss right after an acknowledged submit (or a claim) could
+silently undo the rename — the request-never-lost guarantee needs the
+directory inode durable, not just the file bytes.  Restart-time :meth:`recover` re-enqueues whatever was left in
 ``running/`` (the campaign that claimed it died), which is the "accepted
 requests are never lost" half of the serve contract; the scheduler's
 checkpoint + journal restore the *progress* half.
@@ -35,6 +39,25 @@ from .request import AdmissionError, RequestError, SimRequest
 _STATES = ("queued", "running", "done", "failed")
 
 
+def _fsync_dir(path: str) -> None:
+    """fsync a DIRECTORY: ``os.replace``/``os.remove`` mutate the directory
+    entry, and that mutation is only durable across power loss once the
+    directory inode itself is synced — the file's own fsync covers the
+    bytes, not the name.  Without this, the request-never-lost guarantee
+    rests on the filesystem journaling renames by luck.  Best-effort on
+    filesystems that reject directory fsync (some network mounts)."""
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
 def _atomic_write(path: str, text: str) -> None:
     tmp = f"{path}.{os.getpid()}.tmp"
     with open(tmp, "w", encoding="utf-8") as fh:
@@ -42,6 +65,7 @@ def _atomic_write(path: str, text: str) -> None:
         fh.flush()
         os.fsync(fh.fileno())
     os.replace(tmp, path)
+    _fsync_dir(os.path.dirname(path) or ".")
 
 
 class DurableQueue:
@@ -165,6 +189,8 @@ class DurableQueue:
                 src = os.path.join(self._dir("queued"), name)
                 dst = os.path.join(self._dir("running"), f"{req.id}.json")
                 os.replace(src, dst)
+                _fsync_dir(self._dir("running"))
+                _fsync_dir(self._dir("queued"))
                 return req
         return None
 
@@ -180,6 +206,8 @@ class DurableQueue:
                 src = os.path.join(self._dir("queued"), name)
                 dst = os.path.join(self._dir("running"), f"{req.id}.json")
                 os.replace(src, dst)
+                _fsync_dir(self._dir("running"))
+                _fsync_dir(self._dir("queued"))
                 return req
         return None
 
@@ -192,6 +220,7 @@ class DurableQueue:
             running = os.path.join(self._dir("running"), f"{req.id}.json")
             try:
                 os.remove(running)
+                _fsync_dir(self._dir("running"))
             except OSError:
                 pass  # recovery may already have re-enqueued it
             return path
@@ -218,6 +247,7 @@ class DurableQueue:
             running = os.path.join(self._dir("running"), f"{req.id}.json")
             try:
                 os.remove(running)
+                _fsync_dir(self._dir("running"))
             except OSError:
                 pass
 
@@ -238,6 +268,8 @@ class DurableQueue:
                 self._enqueue(req)
                 os.remove(path)
                 recovered.append(req.id)
+            if recovered:
+                _fsync_dir(self._dir("running"))
         return recovered
 
     # -- introspection --------------------------------------------------------

@@ -195,6 +195,21 @@ class SimRequest:
             progress=0,
         )
 
+    def rebucketed(self, new_dt: float, progress: int = 0) -> "SimRequest":
+        """The PROACTIVE dt re-bucket copy (per-bucket stability ladder,
+        serve/scheduler): dt moved to a new ladder rung, the dt recorded on
+        the trajectory, progress PRESERVED — the member state was finite
+        when the CFL sentinel tripped, so the scheduler parks it and the
+        trajectory continues at the new rung.  Unlike :meth:`backed_off`
+        this consumes no retry: nothing failed, the governor acted early."""
+        new_dt = float(new_dt)
+        return dataclasses.replace(
+            self,
+            dt=new_dt,
+            dts=self.dts + [new_dt],
+            progress=int(progress),
+        )
+
     def to_json(self) -> str:
         return json.dumps(dataclasses.asdict(self), sort_keys=True)
 

@@ -45,6 +45,38 @@ The device-facing machinery is the embedded
 ``session``/``advance``/``checkpoint_now`` surface): fault injection,
 dispatch watchdogs, the async/sharded checkpoint pipeline and the journal
 all come from there — the service adds scheduling, not a second harness.
+
+**Multihost campaigns** (root-coordinated scheduling): every process of a
+multi-process mesh runs ``serve()`` together, but the durable queue, the
+journal, the HTTP front and result flushing are ROOT-ONLY, and every
+per-boundary decision the scheduler makes — bucket selection, slot
+claim/refill assignments, completion/death verdicts, chunk length, the
+dt-re-bucket plan, the drain flag — is computed on root and broadcast
+(:func:`~rustpde_mpi_tpu.parallel.multihost.broadcast_obj`) BEFORE any
+collective dispatch, exactly the treatment the runner's cadence decisions
+already get.  Every host therefore executes the identical
+``set_member``/``mark_dead``/``update_n`` sequence, ``sync_hosts`` fences
+service start/stop and campaign open/close, and the two-phase slot-table
+checkpoint carries the state.
+
+**Elastic fleets**: a restart may resize ``cfg.slots``.  The scheduler
+peeks the checkpoint's member count first, restores onto a fleet of THAT
+size (topology-elastic restore reassembles the state onto whatever mesh
+this incarnation has), then RE-PLANS onto the configured size: kept
+requests move into the new lanes mid-trajectory (``set_member``), surplus
+requests (shrink) are parked — member state held for the lane that will
+next claim them — and re-enqueued at their checkpointed progress, grown
+fleets refill the extra lanes from the queue, and a ``campaign_replanned``
+journal event records old/new K.
+
+**Governed campaign dt** (``cfg.stability``): per-request dt is part of
+the request contract AND the bucket key, so the batch-wide governor stays
+off; instead the on-device CFL sentinels are armed and a per-bucket
+:class:`~rustpde_mpi_tpu.utils.governor.DtLadder` turns a ceiling catch
+into a PROACTIVE re-bucket — the chunk was already rolled back in memory
+while every member is still finite, the pinned requests are requeued WITH
+their state at the next rung down (journal ``bucket_dt_adjust``), and the
+reactive NaN + retry path remains the last resort underneath.
 """
 
 from __future__ import annotations
@@ -103,12 +135,19 @@ class _ServedEnsemble(NavierEnsemble):
 @dataclasses.dataclass
 class _Slot:
     """One ensemble lane: IDLE (masked dead, waiting for work) or RUNNING
-    a request toward ``target`` member-steps (``steps_done`` measured by
-    the ensemble's own per-member counter)."""
+    a request toward ``target`` TOTAL member-steps.  ``base`` counts steps
+    the trajectory completed in EARLIER lane assignments (an elastic
+    re-plan or a dt re-bucket resets the ensemble's per-member counter via
+    ``set_member``), and ``time_base`` the sim-time those steps covered —
+    possibly at a different dt than the current bucket's — so total
+    progress is ``base + steps_done[index]`` and completion is
+    ``base + steps_done >= target``."""
 
     index: int
     req: SimRequest | None = None
     target: int = 0
+    base: int = 0
+    time_base: float = 0.0
 
     @property
     def running(self) -> bool:
@@ -157,6 +196,62 @@ class SimServer:
         self._slots_state: tuple[int, int] = (0, int(self.cfg.slots))
         self._rate_mark: tuple[float, int] = (time.monotonic(), 0)
         self._flops_member: float | None = None
+        # parked mid-flight member states: request id -> (state pytree,
+        # steps completed, sim time completed).  An elastic shrink or a dt
+        # re-bucket releases a lane but keeps the trajectory — the next
+        # lane to claim the id continues it instead of restarting.  Every
+        # host holds the identical dict (parking decisions are broadcast;
+        # the states are the same replicated/sharded device arrays).
+        self._parked: dict[str, tuple] = {}
+        self._replans = 0
+        self._dt_adjusts = 0  # proactive bucket_dt_adjust events
+
+    # -- multihost coordination ----------------------------------------------
+
+    @staticmethod
+    def _nproc() -> int:
+        try:
+            import jax
+
+            return int(jax.process_count())
+        except Exception:
+            return 1
+
+    @staticmethod
+    def _is_root() -> bool:
+        try:
+            from ..parallel import multihost
+
+            return multihost.is_root()
+        except Exception:
+            return True
+
+    def _root_plan(self, build):
+        """Compute one JSON-able scheduling decision on ROOT and broadcast
+        it, so every host executes the identical collective sequence (the
+        queue and the host-fetched counters may only be consulted inside
+        ``build``, which runs on root alone).  Identity single-process."""
+        if self._nproc() == 1:
+            return build()
+        from ..parallel import multihost
+
+        return multihost.broadcast_obj(build() if multihost.is_root() else None)
+
+    def _root_decides(self, local: bool) -> bool:
+        """Root's flag, broadcast (drain/stop handshakes) — the shared
+        :func:`~rustpde_mpi_tpu.parallel.multihost.root_decides` primitive
+        the runner's cadence/preempt handshakes also ride."""
+        from ..parallel import multihost
+
+        return multihost.root_decides(local)
+
+    def _sync(self, tag: str) -> None:
+        """Cross-host fence (service start/stop, campaign open/close)."""
+        if self._nproc() == 1:
+            return
+        from ..parallel import multihost
+
+        multihost.sync_hosts(tag)
 
     # -- client surface -------------------------------------------------------
 
@@ -236,13 +331,45 @@ class SimServer:
 
     def slot_info(self) -> dict:
         """Occupancy of the ACTIVE campaign's ensemble lanes (between
-        campaigns: 0 running over the configured slot count)."""
+        campaigns: 0 running over the configured slot count), plus the
+        fleet shape — process count and mesh topology — so an operator
+        probing ``/healthz`` sees WHAT is serving, not just that it is."""
         running, total = self._slots_state
-        return {
+        info = {
             "running": running,
             "total": total,
             "utilization": (running / total) if total else 0.0,
+            "process_count": self._nproc(),
         }
+        try:
+            import jax
+
+            info["devices"] = int(jax.device_count())
+        except Exception:
+            info["devices"] = 1
+        mesh = self._campaign_mesh()
+        info["mesh"] = (
+            {
+                "shape": [int(n) for n in mesh.devices.shape],
+                "axes": [str(a) for a in mesh.axis_names],
+            }
+            if mesh is not None
+            else None
+        )
+        return info
+
+    def _campaign_mesh(self):
+        """The mesh campaign models are built on: the global pencil mesh on
+        a multi-process runtime (the scheduler's collective dispatches must
+        span every host's devices), None single-controller (the existing
+        single-process behavior, unchanged)."""
+        if self._nproc() == 1:
+            return None
+        if not hasattr(self, "_mesh_cache"):
+            from ..parallel import multihost
+
+            self._mesh_cache = multihost.global_pencil_mesh()
+        return self._mesh_cache
 
     def stats(self) -> dict:
         return {
@@ -250,6 +377,8 @@ class SimServer:
             "completed": self._completed,
             "failed": self._failed,
             "retried": self._retried,
+            "replans": self._replans,
+            "bucket_dt_adjusts": self._dt_adjusts,
             "member_steps": self._member_steps,
             "wall_s": round(time.monotonic() - self._t0, 3),
             "draining": self._drain,
@@ -260,24 +389,33 @@ class SimServer:
 
     def serve(self) -> dict:
         """Run the service until the queue drains (batch mode), or until a
-        drain is requested (daemon mode).  Returns a summary dict."""
+        drain is requested (daemon mode).  Returns a summary dict.
+
+        On a multi-process runtime every host calls this together: root
+        owns the queue/journal/HTTP/results, every scheduling decision is
+        root-broadcast before the collective dispatch it leads into, and
+        ``sync_hosts`` fences the service open/close."""
+        root = self._is_root()
         self._install_signals()
-        self._start_http()
-        unclean = self._detect_unclean_shutdown()
-        recovered = self.queue.recover()
+        if root:
+            self._start_http()
+        unclean = self._detect_unclean_shutdown() if root else False
+        recovered = self.queue.recover() if root else []
         self._journal(
             {
                 "event": "server_start",
                 "slots": self.cfg.slots,
                 "max_queue": self.cfg.max_queue,
+                "processes": self._nproc(),
                 "recovered": recovered,
                 "unclean_shutdown": unclean,
                 "fault": dataclasses.asdict(self._fault) if self._fault else None,
             }
         )
+        self._sync("serve-start")
         try:
-            while not self._drain:
-                key = self._next_bucket()
+            while not self._drain_agreed():
+                key = self._next_bucket_agreed()
                 if key is None:
                     if self.cfg.idle_exit:
                         break
@@ -290,7 +428,8 @@ class SimServer:
             import sys as _sys
 
             if _sys.exc_info()[0] is None:
-                self._flush_results(force=True)
+                if root:
+                    self._flush_results(force=True)
             elif self._pending_results:
                 # an exception (DispatchHang above all) is propagating:
                 # forcing the pending observable futures would device_get
@@ -305,31 +444,73 @@ class SimServer:
                 )
                 self._pending_results = []
             summary = {
-                "outcome": "drained" if self._drain else "idle",
+                # an exception exit (DispatchHang after a peer died, a
+                # wedged collective) is an ERROR outcome: requests may
+                # still be claimed in running/ — the next incarnation must
+                # see this as an unclean shutdown and recover them
+                "outcome": (
+                    "error"
+                    if _sys.exc_info()[0] is not None
+                    else ("drained" if self._drain else "idle")
+                ),
                 **self.stats(),
                 "journal": self.journal_path,
             }
             self._journal({"event": "server_stop", **summary})
-            # service-level metrics flush: one jsonl line at the service
-            # root (campaign runners dump their own under campaigns/<key>)
-            MetricsDumper(
-                os.path.join(self.cfg.run_dir, "metrics.jsonl")
-            ).dump(step=self._global_step)
+            if root:
+                # service-level metrics flush: one jsonl line at the service
+                # root (campaign runners dump their own under campaigns/<key>)
+                MetricsDumper(
+                    os.path.join(self.cfg.run_dir, "metrics.jsonl")
+                ).dump(step=self._global_step)
             self._journal_writer.close()  # reopens lazily if used again
             self._stop_http()
+            if _sys.exc_info()[0] is None:
+                # clean close fences (an exception path must NOT barrier:
+                # the peer that caused it may already be gone)
+                self._sync("serve-stop")
             self._restore_signals()
         return summary
+
+    def _drain_agreed(self) -> bool:
+        """The service-level drain flag, root-decided: a drain request (or
+        signal) lands on root; every host leaves the serve loop together.
+        The broadcast verdict OVERWRITES the local flag — a stray signal on
+        a non-root host must be ignored (the runner's preempt handshake
+        rule), not let that host leave the loop alone and wedge the
+        fleet's next collective."""
+        self._drain = self._root_decides(self._drain)
+        return self._drain
+
+    def _next_bucket_agreed(self) -> tuple | None:
+        """Root picks the bucket (the queue is root's); the key is
+        broadcast so every host builds the identical campaign model."""
+        from ..parallel import multihost
+
+        def pick():
+            key = self._next_bucket()
+            return None if key is None else list(key)
+
+        key = self._root_plan(pick)
+        return multihost.tuplify(key) if key is not None else None
 
     def _detect_unclean_shutdown(self) -> bool:
         """True when the previous incarnation died without a server_stop —
         read through the torn-tail-tolerant reader, since the very crash
-        being detected may have torn the final journal line."""
-        events = [
-            r.get("event")
+        being detected may have torn the final journal line.  A
+        ``server_stop`` with ``outcome: "error"`` counts as UNCLEAN too:
+        the root of a multihost fleet that lost a peer exits structured
+        (watchdogged collective -> journaled stop) but leaves claimed
+        requests behind exactly like a hard kill would."""
+        records = [
+            r
             for r in read_journal(self.journal_path, on_error="skip")
             if r.get("event") in ("server_start", "server_stop")
         ]
-        return bool(events) and events[-1] != "server_stop"
+        if not records:
+            return False
+        last = records[-1]
+        return last["event"] != "server_stop" or last.get("outcome") == "error"
 
     # -- signals / http -------------------------------------------------------
 
@@ -369,6 +550,8 @@ class SimServer:
     # -- journal --------------------------------------------------------------
 
     def _journal(self, event: dict) -> None:
+        if not self._is_root():
+            return  # run_dir is shared on multihost: one journal, root's
         self._journal_writer.append(
             {"wall_s": round(time.monotonic() - self._t0, 3), **event}
         )
@@ -397,12 +580,23 @@ class SimServer:
         tag = hashlib.sha1(repr(key).encode()).hexdigest()[:12]
         return os.path.join(self.cfg.run_dir, "campaigns", tag)
 
-    def _build_runner(self, key: tuple) -> tuple[ResilientRunner, _ServedEnsemble]:
+    def _build_runner(
+        self, key: tuple, k: int | None = None
+    ) -> tuple[ResilientRunner, _ServedEnsemble]:
         # the bucket key IS the model spec: kind-prefixed, scenario-signed —
         # the workloads registry builds whatever physics the bucket needs
-        # (DNS with/without modifiers, lnse, adjoint)
-        model = build_model_for_key(key)
+        # (DNS with/without modifiers, lnse, adjoint); on a multi-process
+        # runtime the model spans the global pencil mesh, so campaign
+        # dispatches are the same collective SPMD programs the runner's
+        # standalone multihost runs execute
+        model = build_model_for_key(key, mesh=self._campaign_mesh())
         model.write_intervall = float("inf")  # no flow-file callback IO
+        if self.cfg.stability is not None:
+            # governed campaigns: arm the on-device sentinels BEFORE the
+            # ensemble vmaps its entry points (per-member CFL + pinned
+            # masks); the dt response is the scheduler's per-bucket ladder
+            # (_settle_predivergence), never a batch-wide governor
+            model.set_stability(self.cfg.stability)
         # per-member step flops for the live MFU gauge: the trace-only jaxpr
         # dot count (no extra compile; the entry points were just built)
         try:
@@ -411,7 +605,8 @@ class SimServer:
             self._flops_member = step_flops(model, method="jaxpr")
         except Exception:
             self._flops_member = None
-        ens = _ServedEnsemble(model, [model.state] * int(self.cfg.slots))
+        k = int(self.cfg.slots if k is None else k)
+        ens = _ServedEnsemble(model, [model.state] * k)
         ens.mark_dead(range(ens.k))  # all lanes idle until a request lands
         rcfg = self.cfg.resilience
         runner = ResilientRunner.from_config(
@@ -435,28 +630,68 @@ class SimServer:
             # dt-backoff retry is the serve-layer stability policy
             stability=None,
         )
+        # the constructor inherits armed sentinels from the model as its
+        # stability config — pin it back off so session() never builds the
+        # batch-wide governor (the sentinels stay armed; the scheduler's
+        # per-bucket ladder consumes their statuses instead)
+        runner.stability = None
         runner.fault = self._fault
         runner.step = self._global_step
         runner.set_journal(self._journal_writer)
         return runner, ens
 
+    def _peek_checkpoint_members(self, run_dir: str) -> int | None:
+        """The member count of the newest valid campaign checkpoint (root
+        scans + broadcasts; None when no checkpoint exists or it carries no
+        ensemble bookkeeping).  The fleet is BUILT at this size so the
+        K-fixed sharded restore always fits, then re-planned onto the
+        configured size (:meth:`_replan_fleet`)."""
+
+        def peek():
+            path = checkpoint.latest_checkpoint(run_dir)
+            if path is None:
+                return None
+            try:
+                root = checkpoint.read_root_data(path)
+            except checkpoint.CheckpointError:
+                return None
+            if "members" not in root:
+                return None
+            return int(np.asarray(root["members"]))
+
+        return self._root_plan(peek)
+
     def _run_campaign(self, key: tuple) -> None:
-        runner, ens = self._build_runner(key)
+        ck_k = self._peek_checkpoint_members(self._campaign_dir(key))
+        runner, ens = self._build_runner(key, k=ck_k)
         self._runner = runner
         self._last_bucket = key  # round-robin cursor
         self._campaign_claims = 0  # fairness quantum consumption
         if self._drain:  # a signal raced the build
             runner.request_drain()
+        self._sync("serve-campaign-open")
         try:
             with runner.session(install_signals=False, resume=False):
                 self._try_resume(runner)
+                if not runner.resumed and ens.k != int(self.cfg.slots):
+                    # the peeked checkpoint was swept (restore failed): no
+                    # state to carry — restart at the configured fleet size
+                    runner, ens = self._swap_fleet(runner, ens)
                 slots = self._restore_slots(runner, ens, key)
+                if ens.k != int(self.cfg.slots):
+                    runner, ens, slots = self._replan_fleet(
+                        runner, ens, slots, key
+                    )
+                _tm.gauge(
+                    "serve_fleet_size", "slot count of the active campaign"
+                ).set(ens.k)
                 self._journal(
                     {
                         "event": "campaign_start",
                         "key": list(key),
                         "dir": runner.run_dir,
                         "restored": runner.resumed,
+                        "fleet": ens.k,
                         "slots_restored": sum(1 for s in slots if s.running),
                     }
                 )
@@ -467,6 +702,7 @@ class SimServer:
             self._global_step = runner.step
             self._runner = None
             self._slots_state = (0, int(self.cfg.slots))
+        self._sync("serve-campaign-close")
 
     def _try_resume(self, runner) -> None:
         """Campaign restore with graceful degradation: a checkpoint that no
@@ -486,8 +722,9 @@ class SimServer:
                     "error": str(exc),
                 }
             )
-            for path in checkpoint.checkpoint_files(runner.run_dir):
-                checkpoint.remove_checkpoint(path)
+            if self._is_root():
+                for path in checkpoint.checkpoint_files(runner.run_dir):
+                    checkpoint.remove_checkpoint(path)
             runner.resumed = False
             runner._last_ckpt_path = None
 
@@ -497,40 +734,69 @@ class SimServer:
         crash recovery did) is RE-CLAIMED into its old lane — the member
         state is already sitting there, bit-equal — and continues from its
         checkpointed step counter.  Restored slots whose request is gone
-        (completed after the checkpoint, durably recorded) go idle."""
+        (completed after the checkpoint, durably recorded) go idle.
+
+        The claims touch the queue, so ROOT builds the restore plan and
+        broadcasts it; every host then applies the identical lane ops."""
         slots = [_Slot(i) for i in range(ens.k)]
         meta = ens.restored_meta if runner.resumed else None
         if not meta:
             return slots
-        alive = ens.alive()
-        for i, m in enumerate(meta[: ens.k]):
-            if not m:
-                continue
-            if not alive[i]:
-                # the member was dead in the checkpoint: leave the request
-                # queued — a fresh lane (fresh IC) will claim it instead of
-                # resuming a doomed trajectory
+        alive = ens.alive()  # replicated (K,) fetches: identical per host
+        done = np.asarray(ens.steps_done)
+
+        def plan_restore():
+            plan = []
+            for i, m in enumerate(meta[: ens.k]):
+                if not m:
+                    continue
+                if not alive[i]:
+                    # the member was dead in the checkpoint: leave the
+                    # request queued — a fresh lane (fresh IC) will claim it
+                    # instead of resuming a doomed trajectory
+                    plan.append({"slot": i, "action": "dead"})
+                    continue
+                req = self.queue.claim_id(m["id"])
+                if req is None:
+                    # the request resolved after this checkpoint was
+                    # written (durably recorded in done/): lane goes idle
+                    plan.append({"slot": i, "action": "resolved"})
+                    continue
+                if req.compat_key != key:
+                    # same id, DIFFERENT bucket: the request was re-queued
+                    # at a new dt after this checkpoint (backoff retry or a
+                    # dt re-bucket) — the old-dt member must not resume it;
+                    # its new bucket's campaign will
+                    self.queue.requeue(req)
+                    plan.append({"slot": i, "action": "rebucketed"})
+                    continue
+                plan.append(
+                    {
+                        "slot": i,
+                        "action": "resume",
+                        "req": req.to_json(),
+                        "target": int(m["target"]),
+                        "base": int(m.get("base", 0)),
+                        "time_base": float(m.get("time_base", 0.0)),
+                    }
+                )
+            return plan
+
+        for entry in self._root_plan(plan_restore):
+            i = entry["slot"]
+            if entry["action"] != "resume":
                 ens.serve_meta[i] = None
+                if entry["action"] in ("resolved", "rebucketed"):
+                    ens.mark_dead([i])
                 continue
-            req = self.queue.claim_id(m["id"])
-            if req is None:
-                # the request resolved after this checkpoint was written
-                # (durably recorded in done/): lane reverts to idle
-                ens.serve_meta[i] = None
-                ens.mark_dead([i])
-                continue
-            if req.compat_key != key:
-                # same id, DIFFERENT bucket: the request diverged after this
-                # checkpoint and was re-queued backed off to a new dt — the
-                # old-dt member state must not resume it (the consumed retry
-                # would never apply the backoff).  Leave it for its new
-                # bucket's campaign.
-                self.queue.requeue(req)
-                ens.serve_meta[i] = None
-                ens.mark_dead([i])
-                continue
-            slots[i].req = req
-            slots[i].target = int(m["target"])
+            req = SimRequest.from_json(entry["req"])
+            slots[i] = _Slot(
+                i,
+                req=req,
+                target=entry["target"],
+                base=entry["base"],
+                time_base=entry["time_base"],
+            )
             self._journal(
                 {
                     "event": "request_scheduled",
@@ -538,10 +804,137 @@ class SimServer:
                     "slot": i,
                     "target": slots[i].target,
                     "restored": True,
-                    "steps_done": int(np.asarray(ens.steps_done)[i]),
+                    "steps_done": entry["base"] + int(done[i]),
                 }
             )
         return slots
+
+    def _swap_fleet(self, runner, ens) -> tuple:
+        """A fresh all-idle fleet at the configured size over the SAME
+        campaign model (no state carried — used when the peeked checkpoint
+        turned out unrestorable)."""
+        model = ens.model
+        new_ens = _ServedEnsemble(model, [model.state] * int(self.cfg.slots))
+        new_ens.mark_dead(range(new_ens.k))
+        new_ens.io_pipeline = getattr(ens, "io_pipeline", None)
+        runner.pde = new_ens
+        return runner, new_ens
+
+    def _replan_fleet(
+        self, runner, old_ens, old_slots: list[_Slot], key: tuple
+    ) -> tuple:
+        """Elastic fleet re-planning: the restored fleet's slot count
+        differs from the configured one.  Restored mid-flight trajectories
+        move into the new lanes (``set_member`` — no recompile, the model
+        is shared); on a SHRINK the surplus trajectories are PARKED (member
+        state held in memory for the next lane to claim them) and their
+        requests re-enqueued at their checkpointed progress; on a GROW the
+        extra lanes refill from the queue through the normal path.  A
+        ``campaign_replanned`` journal event records old/new K, and a fresh
+        checkpoint at the new geometry replaces the stale-K ones (which
+        could never restore this fleet)."""
+        want = int(self.cfg.slots)
+        old_k = old_ens.k
+        new_ens = _ServedEnsemble(old_ens.model, [old_ens.model.state] * want)
+        new_ens.mark_dead(range(new_ens.k))
+        new_ens.time = old_ens.time
+        new_ens.io_pipeline = getattr(old_ens, "io_pipeline", None)
+        done = np.asarray(old_ens.steps_done)
+        running = [s for s in old_slots if s.running]  # identical per host
+
+        def plan_replan():
+            plan = []
+            for j, s in enumerate(running):
+                steps = s.base + int(done[s.index])
+                tdone = s.time_base + int(done[s.index]) * float(s.req.dt)
+                entry = {
+                    "old": s.index,
+                    "req": s.req.to_json(),
+                    "target": int(s.target),
+                    "base": steps,
+                    "time_base": tdone,
+                }
+                if j < want:
+                    entry.update(op="keep", new=j)
+                else:
+                    entry.update(op="park")
+                plan.append(entry)
+            return plan
+
+        kept = parked = 0
+        new_slots = [_Slot(i) for i in range(want)]
+        for entry in self._root_plan(plan_replan):
+            req = SimRequest.from_json(entry["req"])
+            state = old_ens.member_state(entry["old"])  # device op, all hosts
+            if entry["op"] == "keep":
+                j = entry["new"]
+                new_ens.set_member(j, state)
+                new_slots[j] = _Slot(
+                    j,
+                    req=req,
+                    target=entry["target"],
+                    base=entry["base"],
+                    time_base=entry["time_base"],
+                )
+                new_ens.serve_meta[j] = {
+                    "id": req.id,
+                    "target": entry["target"],
+                    "base": entry["base"],
+                    "time_base": entry["time_base"],
+                    "req": json.loads(req.to_json()),
+                }
+                kept += 1
+            else:
+                # park: the trajectory stays continuable in THIS process;
+                # the queued request record is the durable fallback (a
+                # crash before the park is claimed restarts it from scratch)
+                self._parked[req.id] = (
+                    state,
+                    int(entry["base"]),
+                    float(entry["time_base"]),
+                )
+                parked += 1
+                if self._is_root():
+                    self.queue.requeue(
+                        dataclasses.replace(req, progress=int(entry["base"]))
+                    )
+                self._journal(
+                    {
+                        "event": "request_requeued",
+                        "id": req.id,
+                        "slot": entry["old"],
+                        "progress": entry["base"],
+                        "target": entry["target"],
+                        "parked": True,
+                        "checkpoint": None,
+                    }
+                )
+        runner.pde = new_ens
+        self._replans += 1
+        _tm.counter(
+            "serve_replans_total", "elastic fleet re-plans across restarts"
+        ).inc()
+        _tm.gauge(
+            "serve_fleet_size", "slot count of the active campaign"
+        ).set(new_ens.k)
+        self._journal(
+            {
+                "event": "campaign_replanned",
+                "key": list(key),
+                "old_slots": old_k,
+                "new_slots": want,
+                "kept": kept,
+                "parked": parked,
+            }
+        )
+        # anchor the new geometry, then sweep the stale-K checkpoints (a
+        # reactive rollback must never hand this fleet an old-K manifest)
+        path = runner.checkpoint_now("replan")
+        if self._is_root():
+            for p in checkpoint.checkpoint_files(runner.run_dir):
+                if p != path:
+                    checkpoint.remove_checkpoint(p)
+        return runner, new_ens, new_slots
 
     def _refresh_slot_state(self, slots: list[_Slot], total: int) -> None:
         """Keep ``slot_info()`` (/healthz) AND the Prometheus gauge honest
@@ -566,35 +959,89 @@ class SimServer:
         running slots and ends, and the round-robin pick serves the next
         bucket (this bucket's tail gets its next turn).  With no competing
         bucket the quantum is waived (no reason to cycle)."""
-        if self._drain:
-            return
         quantum = int(self.cfg.bucket_quantum)
-        for slot in slots:
-            if slot.running:
-                continue
-            if (
-                quantum > 0
-                and self._campaign_claims >= quantum
-                and self.queue.other_bucket_waiting(key)
-            ):
-                self._journal(
+        idle = [s.index for s in slots if not s.running]
+        if not idle:  # identical slot tables on every host: consistent skip
+            return
+
+        def plan_fill():
+            plan = {"assign": [], "quantum": False, "claims": self._campaign_claims}
+            if self._drain:
+                # drain check lives INSIDE the root plan: a host-local
+                # early-return here would skip the broadcast on the host
+                # the signal landed on while its peers enter it — one
+                # collective out of phase, wedged fleet
+                return plan
+            for i in idle:
+                if (
+                    quantum > 0
+                    and plan["claims"] >= quantum
+                    and self.queue.other_bucket_waiting(key)
+                ):
+                    plan["quantum"] = True
+                    break
+                req = self.queue.claim(key)
+                if req is None:
+                    break
+                plan["claims"] += 1
+                parked = req.id in self._parked
+                if parked:
+                    # requeue-with-state continuation (elastic shrink / dt
+                    # re-bucket): the remaining debt is the request's
+                    # horizon minus the sim time already covered, at the
+                    # CURRENT bucket's dt (re-buckets change it)
+                    _, base, tdone = self._parked[req.id]
+                    target = base + max(
+                        1, round((float(req.horizon) - tdone) / float(req.dt))
+                    )
+                else:
+                    base, tdone, target = 0, 0.0, req.steps
+                plan["assign"].append(
                     {
-                        "event": "bucket_quantum",
-                        "key": list(key),
-                        "claims": self._campaign_claims,
+                        "slot": i,
+                        "req": req.to_json(),
+                        "parked": parked,
+                        "base": base,
+                        "time_base": tdone,
+                        "target": target,
                     }
                 )
-                return
-            req = self.queue.claim(key)
-            if req is None:
-                return
-            self._campaign_claims += 1
-            state = ens.fresh_member_state(req.seed, req.amp or self.cfg.default_amp)
+            return plan
+
+        plan = self._root_plan(plan_fill)
+        self._campaign_claims = int(plan["claims"])
+        if plan["quantum"]:
+            self._journal(
+                {
+                    "event": "bucket_quantum",
+                    "key": list(key),
+                    "claims": self._campaign_claims,
+                }
+            )
+        for a in plan["assign"]:
+            req = SimRequest.from_json(a["req"])
+            slot = slots[a["slot"]]
+            if a["parked"]:
+                # every host holds the identical parked entry (parking
+                # decisions are broadcast) — a missing one is a bug, not a
+                # fallback case
+                state, _, _ = self._parked.pop(req.id)
+            else:
+                state = ens.fresh_member_state(
+                    req.seed, req.amp or self.cfg.default_amp
+                )
             ens.set_member(slot.index, state)
             slot.req = req
-            slot.target = req.steps
-            ens.serve_meta[slot.index] = {"id": req.id, "target": slot.target,
-                                          "req": json.loads(req.to_json())}
+            slot.target = int(a["target"])
+            slot.base = int(a["base"])
+            slot.time_base = float(a["time_base"])
+            ens.serve_meta[slot.index] = {
+                "id": req.id,
+                "target": slot.target,
+                "base": slot.base,
+                "time_base": slot.time_base,
+                "req": json.loads(req.to_json()),
+            }
             self._journal(
                 {
                     "event": "request_scheduled",
@@ -602,6 +1049,8 @@ class SimServer:
                     "slot": slot.index,
                     "target": slot.target,
                     "restored": False,
+                    "parked": bool(a["parked"]),
+                    "base": slot.base,
                     "step": runner.step,
                 }
             )
@@ -631,36 +1080,55 @@ class SimServer:
         self._rate_mark = (now, self._member_steps)
 
     def _campaign_loop(self, runner, ens, slots: list[_Slot], key: tuple) -> None:
+        root = self._is_root()
         while True:
             running = [s for s in slots if s.running]
             if not running:
                 break
-            done = np.asarray(ens.steps_done)
-            n = min(
-                min(s.target - int(done[s.index]) for s in running),
-                int(self.cfg.chunk_steps),
+            done = np.asarray(ens.steps_done)  # replicated (K,): identical
+            n = int(
+                self._root_plan(
+                    lambda: max(
+                        1,
+                        min(
+                            min(
+                                s.target - (s.base + int(done[s.index]))
+                                for s in running
+                            ),
+                            int(self.cfg.chunk_steps),
+                        ),
+                    )
+                )
             )
-            n = max(1, n)
             before = runner.step
             with _tr.span("serve_chunk", steps=n, slots=len(running)):
                 runner.advance(n)
             advanced = runner.step - before
             self._member_steps += advanced * len(running)
+            if self.cfg.stability is not None and ens.pre_divergence_latched:
+                # the chunk rolled back in memory while every member is
+                # still finite: re-bucket the pinned requests down the
+                # per-bucket dt ladder (proactive — no NaN, no checkpoint)
+                self._settle_predivergence(runner, ens, slots, key)
             with _tr.span("serve_settle", step=runner.step):
                 self._settle_boundary(runner, ens, slots, key)
             self._refresh_slot_state(slots, ens.k)
             self._boundary_gauges()
             # boundary housekeeping: deferred sharded commit + cadence
             # checkpoint + the drain/preemption flag — runner.on_boundary is
-            # the same hook integrate() would drive
-            if runner.on_boundary() or self._drain:
+            # the same hook integrate() would drive, and its verdict is
+            # root-broadcast (a local self._drain on root rides the
+            # runner's interrupt flag via request_drain)
+            if runner.on_boundary():
                 self._drain = True
                 self._drain_campaign(runner, ens, slots)
                 return
             self._fill_slots(runner, ens, slots, key)
             self._refresh_slot_state(slots, ens.k)
-            self._flush_results()
-        self._flush_results(force=True)
+            if root:
+                self._flush_results()
+        if root:
+            self._flush_results(force=True)
         self._journal({"event": "campaign_end", "key": list(key),
                        "step": runner.step})
         # a cleanly finished campaign leaves no work to restore: settle the
@@ -668,50 +1136,62 @@ class SimServer:
         # sweep), then remove its checkpoints so a LATER campaign in this
         # bucket starts fresh instead of restoring a stale slot table
         runner._drain_io()
-        for path in checkpoint.checkpoint_files(runner.run_dir):
-            checkpoint.remove_checkpoint(path)
+        if root:
+            for path in checkpoint.checkpoint_files(runner.run_dir):
+                checkpoint.remove_checkpoint(path)
 
     def _settle_boundary(self, runner, ens, slots: list[_Slot], key: tuple) -> None:
         """Process completions and deaths at a chunk boundary.  The
         observables for every slot that finished here ride ONE vmapped
         async dispatch (PR-4 futures) captured BEFORE any lane is refilled,
-        so the fetched values are the finished members' final states."""
+        so the fetched values are the finished members' final states.
+
+        Root decides who finished/died (broadcast); every host executes the
+        identical release/refill lane ops and the observable dispatch."""
         alive = ens.alive()
         done = np.asarray(ens.steps_done)
         # a member that stopped advancing via the model's SUCCESS criterion
         # (the adjoint finder's residual convergence) finished early — it is
-        # a completion, not a death, even below its step target
+        # a completion, not a death, even below its step target.  The
+        # done-ok probe is a device dispatch: EVERY host executes it (a
+        # root-only dispatch would desynchronize the collective program
+        # sequence on a multi-process mesh).
         done_ok = ens.done_ok_members()
-        finished = [
-            s for s in slots
-            if s.running and (
-                (alive[s.index] and int(done[s.index]) >= s.target)
-                or done_ok[s.index]
-            )
-        ]
-        dead = [
-            s for s in slots
-            if s.running and not alive[s.index] and not done_ok[s.index]
-        ]
-        if finished:
-            obs_fut = ens.get_observables_async()
+
+        def decide():
+            finished, dead = [], []
+            for s in slots:
+                if not s.running:
+                    continue
+                total = s.base + int(done[s.index])
+                if (alive[s.index] and total >= s.target) or done_ok[s.index]:
+                    finished.append({"slot": s.index, "steps": total})
+                elif not alive[s.index]:
+                    dead.append({"slot": s.index, "steps": total})
+            return {"finished": finished, "dead": dead}
+
+        plan = self._root_plan(decide)
+        if plan["finished"]:
+            obs_fut = ens.get_observables_async()  # one dispatch, all hosts
             names = tuple(ens.observable_names)
             batch = []
-            for s in finished:
+            for d in plan["finished"]:
+                s = slots[d["slot"]]
                 batch.append(
                     {
                         "slot": s.index,
                         "req": s.req,
                         "names": names,
-                        "steps": int(done[s.index]),
+                        "steps": int(d["steps"]),
                         "finished_wall": time.time(),
                         "step": runner.step,
                     }
                 )
                 self._release(ens, s)
-            self._pending_results.append((obs_fut, batch))
-        for s in dead:
-            self._handle_death(runner, ens, s, int(done[s.index]))
+            if self._is_root():
+                self._pending_results.append((obs_fut, batch))
+        for d in plan["dead"]:
+            self._handle_death(runner, ens, slots[d["slot"]], int(d["steps"]))
 
     def _release(self, ens, slot: _Slot) -> None:
         """Lane back to idle (masked dead until refilled)."""
@@ -719,6 +1199,104 @@ class SimServer:
         ens.mark_dead([slot.index])
         slot.req = None
         slot.target = 0
+        slot.base = 0
+        slot.time_base = 0.0
+
+    def _settle_predivergence(
+        self, runner, ens, slots: list[_Slot], key: tuple
+    ) -> None:
+        """Per-bucket governed dt (``cfg.stability``): the sentinel chunk
+        tripped the hard CFL ceiling and was already rolled back in memory
+        — every member is still FINITE.  Root sizes the drop on the
+        bucket's :class:`~rustpde_mpi_tpu.utils.governor.DtLadder` (rung
+        floats are exact, so every re-bucketed request lands in the SAME
+        new bucket and co-batches there) and broadcasts the plan; the
+        pinned requests are requeued WITH their state (parked, like an
+        elastic shrink) at the new rung, journal-typed ``bucket_dt_adjust``.
+        A ladder with no rung left falls back to the reactive per-request
+        retry path — the proactive ladder sits ABOVE it, never replaces it."""
+        status = ens.last_chunk_status
+        stab = self.cfg.stability
+        done = np.asarray(ens.steps_done)
+
+        def decide():
+            from ..utils.governor import DtLadder
+
+            bucket_dt = float(ens.get_dt())
+            pinned = [
+                s
+                for s in slots
+                if s.running and status.pinned and status.pinned[s.index]
+            ]
+            new_dt = rung = None
+            floor = stab.dt_min
+            if floor is None or bucket_dt > floor * (1.0 + 1e-12):
+                ladder = DtLadder(
+                    bucket_dt,
+                    ratio=stab.ladder_ratio,
+                    dt_min=floor,
+                    dt_max=bucket_dt,
+                )
+                down = ladder.rungs_to_target(status.cfl_max, stab.target_cfl)
+                rung = ladder.clamp(-down)
+                new_dt = ladder.dt(rung) if rung < 0 else None
+            return {
+                "new_dt": new_dt,
+                "rung": rung,
+                "cfl": float(status.cfl_max),
+                "slots": [
+                    {
+                        "slot": s.index,
+                        "steps": s.base + int(done[s.index]),
+                        "time": s.time_base
+                        + int(done[s.index]) * float(s.req.dt),
+                    }
+                    for s in pinned
+                ],
+            }
+
+        plan = self._root_plan(decide)
+        for entry in plan["slots"]:
+            s = slots[entry["slot"]]
+            if plan["new_dt"] is None:
+                # ladder exhausted (dt_min floor): the reactive per-request
+                # dt-backoff/terminal-failure policy takes over
+                self._handle_death(runner, ens, s, int(entry["steps"]))
+                continue
+            req = s.req
+            state = ens.member_state(s.index)  # finite: rolled-back chunk
+            self._release(ens, s)
+            self._parked[req.id] = (
+                state,
+                int(entry["steps"]),
+                float(entry["time"]),
+            )
+            if self._is_root():
+                self.queue.requeue(
+                    req.rebucketed(plan["new_dt"], progress=int(entry["steps"]))
+                )
+            self._dt_adjusts += 1
+            _tm.counter(
+                "serve_bucket_dt_adjusts_total",
+                "proactive per-bucket dt re-buckets",
+            ).inc()
+            _tm.gauge(
+                "serve_bucket_dt_rung",
+                "ladder rung of the latest dt re-bucket (relative, <0)",
+            ).set(plan["rung"])
+            self._journal(
+                {
+                    "event": "bucket_dt_adjust",
+                    "id": req.id,
+                    "slot": entry["slot"],
+                    "prev_dt": float(req.dt),
+                    "dt": plan["new_dt"],
+                    "rung": plan["rung"],
+                    "cfl": plan["cfl"],
+                    "steps_done": entry["steps"],
+                }
+            )
+        ens.clear_pre_divergence()
 
     def _handle_death(self, runner, ens, slot: _Slot, steps_done: int) -> None:
         """Per-request divergence policy: bounded dt-backoff retry, then
@@ -728,7 +1306,8 @@ class SimServer:
         self._release(ens, slot)
         if req.retries < self.cfg.request_max_retries:
             retry = req.backed_off(self.cfg.request_dt_backoff)
-            self.queue.requeue(retry)
+            if self._is_root():
+                self.queue.requeue(retry)
             self._retried += 1
             _tm.counter(
                 "serve_requests_retried_total", "diverged requests re-queued backed off"
@@ -748,7 +1327,8 @@ class SimServer:
                 f"diverged at member-step {steps_done}/{req.steps} and "
                 f"exhausted {self.cfg.request_max_retries} retries"
             )
-            self.queue.fail(req, reason)
+            if self._is_root():
+                self.queue.fail(req, reason)
             self._failed += 1
             _tm.counter(
                 "serve_requests_failed_total", "requests in the typed terminal state"
@@ -767,7 +1347,10 @@ class SimServer:
         """Resolve finished-request observable futures and write the done
         records.  Non-blocking by default (a future still in flight stays
         pending — the stream, not the device, waits); ``force`` resolves
-        everything (campaign end / server stop)."""
+        everything (campaign end / server stop).  Root-only: results and
+        the queue belong to root."""
+        if not self._is_root():
+            return
         keep = []
         for fut, batch in self._pending_results:
             if not force and not fut.ready():
@@ -824,9 +1407,11 @@ class SimServer:
 
     def _drain_campaign(self, runner, ens, slots: list[_Slot]) -> None:
         """The graceful-drain path: flush resolved results, checkpoint the
-        slot table + member states through the sharded two-phase writer,
-        then re-enqueue every unfinished request (progress stamped for the
-        record; the checkpoint is what actually restores it)."""
+        slot table + member states through the sharded two-phase writer
+        (collective — every host is here together, the drain verdict was
+        root-broadcast), then re-enqueue every unfinished request on root
+        (progress stamped for the record; the checkpoint is what actually
+        restores it)."""
         self._flush_results(force=True)
         _tr.instant("drain", step=runner.step)
         running = [s for s in slots if s.running]
@@ -835,8 +1420,11 @@ class SimServer:
             path = runner.checkpoint_now("drain")
         done = np.asarray(ens.steps_done)
         for s in running:
-            req = dataclasses.replace(s.req, progress=int(done[s.index]))
-            self.queue.requeue(req)
+            req = dataclasses.replace(
+                s.req, progress=s.base + int(done[s.index])
+            )
+            if self._is_root():
+                self.queue.requeue(req)
             self._journal(
                 {
                     "event": "request_requeued",

@@ -201,6 +201,21 @@ def verify_snapshot(filename: str) -> dict:
     return attrs
 
 
+def read_root_data(filename: str) -> dict:
+    """Root-level (replicated, digest-covered) datasets of a snapshot as
+    numpy arrays, WITHOUT assembling any state — the cheap metadata peek
+    the serve scheduler uses to learn a checkpoint's slot geometry
+    (``members``, ``serve_slots``) before deciding how to size the fleet.
+    For a sharded manifest these are the manifest-root datasets; for a
+    gathered snapshot, the root datasets next to the state groups."""
+    out: dict[str, np.ndarray] = {}
+    with _open_checkpoint(filename) as h5:
+        for name, obj in h5.items():
+            if name != _MANIFEST_DS and hasattr(obj, "shape"):
+                out[name] = np.asarray(obj)
+    return out
+
+
 @dataclasses.dataclass
 class HostSnapshot:
     """A snapshot fully fetched to host memory, not yet on disk.

@@ -463,18 +463,14 @@ class ResilientRunner:
         (preemption stop, cadence checkpoint): on a multihost mesh rank 0's
         flag is broadcast so every host takes the same branch — hosts
         evaluating wall clocks or signals locally would disagree and wedge
-        the next collective.  Single-host: the local flag."""
+        the next collective.  Single-host: the local flag.  One shared
+        primitive (:func:`~rustpde_mpi_tpu.parallel.multihost.root_decides`)
+        — the serve scheduler's handshakes ride the identical code."""
         try:
-            import jax
-
-            multi = jax.process_count() > 1
-        except Exception:
-            multi = False
-        if not multi:
+            from ..parallel import multihost
+        except Exception:  # no runtime at all: the local path is the only one
             return bool(local)
-        from ..parallel import multihost
-
-        return bool(int(multihost.broadcast(np.int32(1 if local else 0))))
+        return multihost.root_decides(local)
 
     def _preempt_agreed(self) -> bool:
         """Preemption stop (a stray local signal on a non-root host is

@@ -103,6 +103,11 @@ def main() -> int:
         # the durability harness's footprint rides the test record so a
         # shard-layout regression is visible across PRs
         "sharded_io": _sharded_io_counters(),
+        # multihost-serve counters from the serve129 row's 2-proc CPU leg
+        # (drain/replan/dt-adjust trajectory of the root-coordinated
+        # scheduler) — the multihost serving path gets the same tracked
+        # record the two-phase writer has
+        "serve_mp": _serve_mp_counters(),
         # per-model solo-vs-ensemble parity deltas (workloads satellite):
         # recorded into PARITY.json too, so cross-model vmap/scan drift
         # shows up per-PR next to the Nu-parity numbers
@@ -178,6 +183,35 @@ def _sharded_io_counters() -> dict | None:
             "cross_topology_restore_equal": row.get(
                 "cross_topology_restore_equal"
             ),
+        }
+    except (OSError, ValueError, KeyError):
+        return None
+
+
+def _serve_mp_counters() -> dict | None:
+    """Drain/replan/dt-adjust counters from BENCH_FULL.json's ``serve129``
+    2-process leg (None when the config was never benched — or predates
+    the multihost scheduler)."""
+    try:
+        with open(os.path.join(_REPO, "BENCH_FULL.json")) as f:
+            row = json.load(f)["results"]["serve129"]
+        mp = row.get("multiprocess")
+        if not isinstance(mp, dict):
+            return None
+        return {
+            key: mp.get(key)
+            for key in (
+                "nproc",
+                "completed",
+                "drains",
+                "requeued",
+                "replans",
+                "dt_adjusts",
+                "restored_mid_trajectory",
+                "zero_lost",
+                "error",
+            )
+            if key in mp
         }
     except (OSError, ValueError, KeyError):
         return None

@@ -18,6 +18,14 @@ One process of an N-process ``jax.distributed`` run on CPU devices.  Modes
 * ``bench_sharded`` — times sharded-vs-gathered checkpoint writes for
   ``bench.py shardedio129`` (repetitions, bytes/host, and the final-state
   dump for the parent's cross-topology restore gate).
+* ``serve_campaign`` — runs a :class:`~rustpde_mpi_tpu.serve.SimServer`
+  across the 2-process mesh (root-coordinated scheduling: root owns the
+  queue/journal, every slot decision is broadcast).  Root enqueues
+  ``RUSTPDE_MP_SERVE_REQUESTS`` requests on the FIRST incarnation (the
+  queue directory is the idempotence guard); faults come from
+  ``RUSTPDE_FAULT`` (SIGTERM drain, host-scoped SIGKILL, batch NaN) and
+  the slot count from ``RUSTPDE_MP_SERVE_SLOTS`` so restarts can resize
+  the fleet (elastic re-plan).  Root dumps summary + journal counters.
 
 argv: coordinator_port process_id num_processes out_dir [mode]
 """
@@ -228,6 +236,84 @@ def mode_bench_sharded(out_dir, reps=3):
             )
 
 
+def mode_serve_campaign(out_dir):
+    from rustpde_mpi_tpu.config import ServeConfig
+    from rustpde_mpi_tpu.parallel import multihost
+    from rustpde_mpi_tpu.serve import AdmissionError, SimServer
+    from rustpde_mpi_tpu.utils.journal import read_journal
+
+    n_req = int(os.environ.get("RUSTPDE_MP_SERVE_REQUESTS", "5"))
+    slots = int(os.environ.get("RUSTPDE_MP_SERVE_SLOTS", "2"))
+    run_dir = os.path.join(out_dir, "serve")
+    cfg = ServeConfig(
+        run_dir=run_dir,
+        slots=slots,
+        max_queue=4 * n_req,
+        chunk_steps=4,
+        checkpoint_every_s=2.0,  # tight cadence: a SIGKILL must leave a
+        # recent slot-table checkpoint to restore mid-trajectory from
+        http_port=None,
+    )
+    srv = SimServer(cfg)  # fault rides RUSTPDE_FAULT (host-scoped specs ok)
+    if multihost.is_root():
+        counts = srv.queue.counts()
+        if sum(counts.values()) == 0:  # first incarnation only
+            for seed in range(n_req):
+                # 34^2 grid: spectral dims divide the 4-device mesh; the
+                # jittered horizon staggers completions off one boundary
+                try:
+                    srv.submit(
+                        {
+                            "ra": 1e4,
+                            "pr": 1.0,
+                            "nx": 34,
+                            "ny": 34,
+                            "dt": 0.01,
+                            "horizon": 0.08 + (seed % 3) * 0.02,
+                            "seed": seed,
+                        }
+                    )
+                except AdmissionError:
+                    pass
+    summary = srv.serve()
+    if multihost.is_root():
+        events = [
+            e.get("event")
+            for e in read_journal(
+                os.path.join(run_dir, "journal.jsonl"), on_error="skip"
+            )
+        ]
+        with open(os.path.join(out_dir, "result.json"), "w") as f:
+            json.dump(
+                {
+                    "outcome": summary["outcome"],
+                    "completed": summary["completed"],
+                    "failed": summary["failed"],
+                    "retried": summary["retried"],
+                    "replans": summary["replans"],
+                    "queue": srv.queue.counts(),
+                    "slots": slots,
+                    "nproc": jax.process_count(),
+                    "drains": events.count("drain"),
+                    "requeued": events.count("request_requeued"),
+                    "replanned": events.count("campaign_replanned"),
+                    "dt_adjusts": events.count("bucket_dt_adjust"),
+                    "retries": events.count("request_retry"),
+                    "restored_sched": sum(
+                        1
+                        for e in read_journal(
+                            os.path.join(run_dir, "journal.jsonl"),
+                            on_error="skip",
+                        )
+                        if e.get("event") == "request_scheduled"
+                        and e.get("restored")
+                        and e.get("steps_done", 0) > 0
+                    ),
+                },
+                f,
+            )
+
+
 def main():
     port, pid, nproc, out_dir = (
         sys.argv[1],
@@ -252,6 +338,8 @@ def main():
         mode_sharded_run(out_dir)
     elif mode == "bench_sharded":
         mode_bench_sharded(out_dir)
+    elif mode == "serve_campaign":
+        mode_serve_campaign(out_dir)
     else:
         raise SystemExit(f"unknown mode {mode!r}")
     print(f"RANK{pid} OK", flush=True)

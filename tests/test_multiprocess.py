@@ -168,6 +168,111 @@ def test_sharded_two_phase_kill_then_resume(tmp_path):
     assert model.time == pytest.approx(float(dumped["time"]))
 
 
+def _serve_solo_nu(result):
+    """Solo serial rerun of one served request's trajectory (the 2-proc
+    campaign must be member- AND topology-isolated: vmapped on a 4-device
+    2-process mesh == the plain serial model, to the serve tolerance)."""
+    from rustpde_mpi_tpu import Navier2D
+
+    m = Navier2D(34, 34, 1e4, 1.0, result["dt"], 1.0, "rbc", periodic=False)
+    m.init_random(result.get("amp") or 0.1, seed=result["seed"])
+    m.update_n(result["steps"])
+    return float(m.eval_nu())
+
+
+def test_multiprocess_serve_campaign_chaos_soak(tmp_path):
+    """THE multihost-serving gate (ISSUE 10 acceptance): one durable queue
+    of requests served by a 2-process root-coordinated campaign through
+    three failure axes —
+
+    1. SIGTERM drain mid-campaign (``kill@`` hits every host; root
+       broadcasts the stop, the sharded slot-table checkpoint commits,
+       unfinished requests re-enqueue, both ranks exit clean);
+    2. host-scoped SIGKILL (``kill@..:host1``): rank 1 dies mid-flight,
+       rank 0's watchdogs convert the wedged collective into a structured
+       nonzero exit — no manifest torn, requests stay claimed on disk;
+    3. restart with a DIFFERENT slot count + batch NaN: the fleet re-plans
+       (``campaign_replanned``), drained/killed trajectories restore
+       mid-flight, the NaN'd batch retries at dt/2, and the queue drains.
+
+    Zero requests lost or failed, and sampled results match solo serial
+    reruns to the serve isolation tolerance."""
+    import numpy as np
+
+    from rustpde_mpi_tpu.serve import DurableQueue
+    from rustpde_mpi_tpu.utils.journal import read_journal
+
+    out_dir = str(tmp_path / "mpserve")
+    os.makedirs(out_dir, exist_ok=True)
+    n_req = 5
+    base = {
+        "RUSTPDE_MP_SERVE_REQUESTS": str(n_req),
+        "RUSTPDE_SYNC_TIMEOUT_S": "60",
+        "RUSTPDE_DISPATCH_TIMEOUT_S": "60",
+    }
+
+    # phase 1: enqueue everything, drain at step 6 (SIGTERM on every host)
+    _spawn(
+        out_dir,
+        "serve_campaign",
+        env_extra={**base, "RUSTPDE_MP_SERVE_SLOTS": "2",
+                   "RUSTPDE_FAULT": "kill@6"},
+    )
+    with open(os.path.join(out_dir, "result.json")) as f:
+        r1 = json.load(f)
+    assert r1["outcome"] == "drained" and r1["requeued"] >= 1
+
+    # phase 2: resume 2-proc, rank 1 dies HARD mid-campaign; rank 0 must
+    # exit structured (watchdog), not wedge forever
+    outs = _spawn(
+        out_dir,
+        "serve_campaign",
+        env_extra={**base, "RUSTPDE_MP_SERVE_SLOTS": "2",
+                   "RUSTPDE_FAULT": "kill@12:host1"},
+        check=False,
+    )
+    assert outs[1][0] != 0, "rank 1 should die at the SIGKILL fault"
+    assert outs[0][0] != 0, "rank 0 must not report success without its peer"
+
+    # phase 3: restart with a GROWN fleet (elastic re-plan on 2 processes)
+    # + a batch NaN; everything completes
+    _spawn(
+        out_dir,
+        "serve_campaign",
+        env_extra={**base, "RUSTPDE_MP_SERVE_SLOTS": "3",
+                   "RUSTPDE_FAULT": "nan@18"},
+    )
+    with open(os.path.join(out_dir, "result.json")) as f:
+        r3 = json.load(f)
+    assert r3["outcome"] == "idle"
+    assert r3["queue"] == {
+        "queued": 0, "running": 0, "done": n_req, "failed": 0
+    }
+    assert r3["replanned"] >= 1  # 2-slot checkpoint re-planned onto 3
+    assert r3["restored_sched"] >= 1  # trajectories restored mid-flight
+    assert r3["retries"] >= 1  # the NaN chaos actually fired
+
+    events = read_journal(
+        os.path.join(out_dir, "serve", "journal.jsonl"), on_error="skip"
+    )
+    names = [e.get("event") for e in events]
+    assert "drain" in names and "request_requeued" in names
+    assert "campaign_replanned" in names
+    starts = [e for e in events if e.get("event") == "server_start"]
+    assert starts[-1]["processes"] == _NPROC
+    assert starts[-1]["unclean_shutdown"] is True  # phase 2's SIGKILL seen
+
+    # isolation + topology equivalence: sampled done records vs solo
+    # serial reruns (2-proc vmapped members == plain serial model)
+    done_dir = os.path.join(out_dir, "serve", "queue", "done")
+    sample = sorted(os.listdir(done_dir))[:3]
+    for name in sample:
+        with open(os.path.join(done_dir, name)) as fh:
+            res = json.load(fh)["result"]
+        solo = _serve_solo_nu(res)
+        assert abs(res["nu"] - solo) <= 1e-9 * max(abs(solo), 1e-30)
+
+
 def test_sharded_multiprocess_matches_serial_run(tmp_path):
     """A clean 2-process sharded-checkpoint run equals the serial model
     driven over the same horizon (the resilience layer must not perturb

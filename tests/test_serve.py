@@ -372,12 +372,20 @@ def test_serve_admission_and_http_front(tmp_path):
                 return resp.status, resp.read().decode("utf-8")
 
         # enriched /healthz: liveness PLUS queue depth + slot utilization
+        # PLUS the fleet shape (process count / mesh topology) an operator
+        # needs to see what is serving, not just that it is up
         code, health = get("/healthz")
         assert code == 200
         assert health["ok"] is True and health["draining"] is False
         assert set(health["queue"]) == {"queued", "running", "done", "failed"}
-        assert set(health["slots"]) == {"running", "total", "utilization"}
+        assert {
+            "running", "total", "utilization",
+            "process_count", "devices", "mesh",
+        } <= set(health["slots"])
         assert health["slots"]["total"] == 2
+        assert health["slots"]["process_count"] == 1
+        assert health["slots"]["devices"] >= 1
+        assert health["slots"]["mesh"] is None  # single-controller run
         code, ack = post("/requests", dict(_REQ, seed=0))
         assert code == 202 and ack["steps"] == 10
         code, err = post("/requests", dict(_REQ, dt=-1.0))
@@ -599,25 +607,160 @@ def test_runner_embedding_surface(tmp_path, stepped_rbc17):
         assert runner.on_boundary() is True  # the embedder's stop signal
 
 
-def test_drain_checkpoint_with_changed_slots_degrades_gracefully(tmp_path):
-    """Restart with a different slot count: the K-fixed sharded restore
-    cannot fit the old slot table — the service must sweep the
-    incompatible checkpoints and restart the requests from scratch (still
-    durably queued), not brick on a CheckpointError."""
+def test_drain_restart_grow_replans_and_continues(tmp_path):
+    """Elastic fleet GROW across a drain/restart cycle: the restart builds
+    the fleet at the checkpoint's slot count, restores every drained
+    trajectory MID-FLIGHT, then re-plans onto the larger configured fleet
+    — kept requests continue from their checkpointed step counters in the
+    new lanes, grown lanes refill from the queue, and the journal records
+    a ``campaign_replanned`` event with old/new K."""
     srv = SimServer(_cfg(tmp_path, slots=2), fault="kill@8")
-    ids = [srv.submit(dict(_REQ, seed=s, horizon=0.2)).id for s in range(3)]
+    ids = [srv.submit(dict(_REQ, seed=s, horizon=0.2)).id for s in range(4)]
     assert srv.serve()["outcome"] == "drained"
 
-    srv2 = SimServer(_cfg(tmp_path, slots=3))  # ops resized the fleet
+    srv2 = SimServer(_cfg(tmp_path, slots=3))  # ops grew the fleet
     s2 = srv2.serve()
     assert s2["outcome"] == "idle"
-    assert srv2.queue.counts()["done"] == 3 and s2["failed"] == 0
-    events = [e["event"] for e in _events(str(tmp_path / "serve"))]
-    assert "campaign_restore_failed" in events
+    assert srv2.queue.counts()["done"] == 4 and s2["failed"] == 0
+    assert s2["replans"] == 1
+    events = _events(str(tmp_path / "serve"))
+    replans = [e for e in events if e["event"] == "campaign_replanned"]
+    assert len(replans) == 1
+    assert replans[0]["old_slots"] == 2 and replans[0]["new_slots"] == 3
+    assert replans[0]["kept"] == 2 and replans[0]["parked"] == 0
+    # NOT the degrade path: the old checkpoint restored, nothing was swept
+    assert all(e["event"] != "campaign_restore_failed" for e in events)
+    # the kept requests came back mid-trajectory (steps_done > 0)
+    restored = [
+        e for e in events
+        if e["event"] == "request_scheduled" and e.get("restored")
+    ]
+    assert len(restored) == 2
+    assert all(e["steps_done"] > 0 for e in restored)
     for rid in ids:
         res = srv2.result(rid)
         assert res["steps"] == 20
         assert res["nu"] == pytest.approx(_solo_nu(res), rel=1e-9)
+    # fleet-shape telemetry: the re-plan left its marks on the live gauges
+    from rustpde_mpi_tpu import telemetry
+
+    snap = telemetry.snapshot()
+    assert "serve_fleet_size" in snap and "serve_replans_total" in snap
+
+
+def test_drain_restart_shrink_replans_parks_and_continues(tmp_path):
+    """Elastic fleet SHRINK: 3 drained mid-flight trajectories restart on
+    a 2-slot fleet.  Two move into the new lanes; the surplus one is
+    PARKED (member state held) and re-enqueued at its checkpointed
+    progress — when a lane frees it continues MID-FLIGHT (scheduled with
+    ``parked: true`` and a nonzero base), and its final result still
+    matches the full solo trajectory."""
+    srv = SimServer(_cfg(tmp_path, slots=3), fault="kill@8")
+    ids = [srv.submit(dict(_REQ, seed=s, horizon=0.2)).id for s in range(3)]
+    assert srv.serve()["outcome"] == "drained"
+
+    srv2 = SimServer(_cfg(tmp_path, slots=2))  # ops shrank the fleet
+    s2 = srv2.serve()
+    assert s2["outcome"] == "idle"
+    assert srv2.queue.counts()["done"] == 3 and s2["failed"] == 0
+    events = _events(str(tmp_path / "serve"))
+    replans = [e for e in events if e["event"] == "campaign_replanned"]
+    assert len(replans) == 1
+    assert replans[0]["old_slots"] == 3 and replans[0]["new_slots"] == 2
+    assert replans[0]["kept"] == 2 and replans[0]["parked"] == 1
+    # the surplus request was requeued parked at its checkpointed progress
+    parked_requeues = [
+        e for e in events
+        if e["event"] == "request_requeued" and e.get("parked")
+    ]
+    assert len(parked_requeues) == 1 and parked_requeues[0]["progress"] > 0
+    # ... and later CONTINUED mid-flight in a freed lane, not restarted
+    parked_scheduled = [
+        e for e in events
+        if e["event"] == "request_scheduled" and e.get("parked")
+    ]
+    assert len(parked_scheduled) == 1
+    assert parked_scheduled[0]["id"] == parked_requeues[0]["id"]
+    assert parked_scheduled[0]["base"] == parked_requeues[0]["progress"]
+    for rid in ids:
+        res = srv2.result(rid)
+        assert res["steps"] == 20
+        assert res["nu"] == pytest.approx(_solo_nu(res), rel=1e-9)
+
+
+def test_serve_governed_bucket_dt_rebucket(tmp_path, monkeypatch):
+    """The governed-campaign gate, in-process: a velocity spike hits the
+    running bucket mid-campaign.  With ``cfg.stability`` armed the
+    on-device CFL sentinels catch it while every member is still FINITE,
+    the chunk rolls back in memory, and the pinned requests are re-bucketed
+    at a lower rung of the per-bucket dt ladder WITH their state (journal
+    ``bucket_dt_adjust``) — the campaign finishes with ZERO reactive
+    retries and zero failures, where the ungoverned path would NaN and
+    burn the per-request retry budget."""
+    from rustpde_mpi_tpu.config import StabilityConfig
+
+    # size the spike well past the CFL ceiling: the base flow at this
+    # config runs at CFL ~0.035 and the spike partially decays through the
+    # step's velocity recomputation, so x500 lands the chunk at CFL ~3.4 —
+    # over the 1.0 ceiling with margin, under the NaN horizon
+    monkeypatch.setenv("RUSTPDE_SPIKE_FACTOR", "500")
+    srv = SimServer(
+        _cfg(tmp_path, slots=2, stability=StabilityConfig(ladder_ratio=4.0)),
+        fault="spike@6",
+    )
+    ids = [srv.submit(dict(_REQ, seed=s)).id for s in range(2)]
+    summary = srv.serve()
+    assert summary["outcome"] == "idle"
+    assert summary["completed"] == 2 and summary["failed"] == 0
+    assert summary["retried"] == 0  # zero REACTIVE retries: caught pre-NaN
+    assert summary["bucket_dt_adjusts"] >= 2  # both pinned members moved
+    events = _events(srv.cfg.run_dir)
+    names = [e["event"] for e in events]
+    assert "bucket_dt_adjust" in names
+    assert "request_retry" not in names  # the reactive path never fired
+    adjusts = [e for e in events if e["event"] == "bucket_dt_adjust"]
+    assert all(e["dt"] < e["prev_dt"] for e in adjusts)
+    assert all(e["rung"] < 0 and e["cfl"] > 0 for e in adjusts)
+    # the re-bucketed requests CONTINUED (parked state, nonzero base) and
+    # completed at the reduced dt with MORE total steps, finite results
+    import math
+
+    for rid in ids:
+        res = srv.result(rid)
+        assert res["dt"] < 0.01 and res["steps"] > 10
+        assert res["retries"] == 0
+        assert math.isfinite(res["nu"])
+    sched = [
+        e for e in events
+        if e["event"] == "request_scheduled" and e.get("parked")
+    ]
+    assert len(sched) >= 2 and all(e["base"] > 0 for e in sched)
+    from rustpde_mpi_tpu import telemetry
+
+    assert "serve_bucket_dt_rung" in telemetry.snapshot()
+
+
+def test_serve_governed_stable_dt_bit_identical(tmp_path):
+    """At a stable dt the governed campaign must be BIT-identical to the
+    ungoverned one: the sentinels only reduce arrays the step already
+    materializes, and with no ceiling trip the scheduler takes the exact
+    same claim/chunk/settle sequence."""
+    from rustpde_mpi_tpu.config import StabilityConfig
+
+    results = {}
+    for tag, stab in (("plain", None), ("governed", StabilityConfig())):
+        srv = SimServer(
+            _cfg(tmp_path, run_dir=str(tmp_path / tag), slots=2, stability=stab)
+        )
+        ids = [srv.submit(dict(_REQ, seed=s)).id for s in range(3)]
+        summary = srv.serve()
+        assert summary["completed"] == 3 and summary["failed"] == 0
+        results[tag] = [srv.result(r) for r in ids]
+    for plain, governed in zip(results["plain"], results["governed"]):
+        assert plain["steps"] == governed["steps"]
+        assert plain["nu"] == governed["nu"]  # bit-equal, not approx
+        assert plain["nuvol"] == governed["nuvol"]
+        assert plain["re"] == governed["re"]
 
 
 def _solo_lnse_energy(result):
