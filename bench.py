@@ -74,6 +74,7 @@ DEFAULT_CONFIGS = [
     "shardedio129",
     "serve129",
     "workloads129",
+    "pallasconv",
     "periodic",
     "poisson1025",
     "poisson1025_f64",
@@ -101,6 +102,7 @@ METRIC_NAMES = {
     "shardedio129": "2D RBC sharded two-phase checkpoints, 2-proc CPU harness (sharded vs gathered write + elastic-restore gate)",
     "serve129": "2D RBC simulation service 129x129 Ra=1e7, 200 requests / 8 slots soak (drain+NaN chaos; member-steps/s + latency percentiles)",
     "workloads129": "multi-model workloads 129x129 (dns/lnse/adjoint member-steps/s per kind + solo-vs-ensemble parity + lnse onset-sign gate)",
+    "pallasconv": "fused Pallas convection chain vs unfused dense (RUSTPDE_CONV_KERNEL A/B: ms/step + MFU + bit-tolerance deltas; 129x129 min, flagship rows on-chip)",
     "periodic": "2D RBC periodic 128x65 Ra=1e6",
     "periodic1024": "2D RBC periodic 1024x1025 Ra=1e9",
     "poisson1025": "Poisson standalone 1025x1025",
@@ -958,6 +960,115 @@ def bench_serve(nx=129, ny=129, ra=1e7, dt=2e-3, steps_per_req=8):
         shutil.rmtree(run_dir, ignore_errors=True)
 
 
+def bench_pallasconv(steps=8):
+    """Fused Pallas convection chain vs the unfused dense chain
+    (RUSTPDE_CONV_KERNEL knob, ops/pallas_conv.py): ms/step, MFU and
+    bit-tolerance deltas per grid.
+
+    Off-TPU the kernel runs in interpreter mode, so the ms/step numbers
+    measure plumbing, not the chip — the honest speed A/B lands when a TPU
+    is attached (the flagship rows 1025^2/2049^2/periodic1024 auto-enable
+    there).  The gates that hold everywhere: parity within the documented
+    tolerance (f64 1e-10, f32 1e-3 relative after 8 steps) and
+    ``recompile_count`` FLAT across kernel-knob flips on live models (the
+    knob binds at model build, never mid-run)."""
+    import jax
+    import numpy as np
+
+    from rustpde_mpi_tpu import Navier2D, config
+    from rustpde_mpi_tpu.utils.profiling import benchmark_steps, mfu_estimate
+
+    config.enable_compilation_cache()
+    on_chip = jax.devices()[0].platform in ("tpu", "axon")
+    cases = [("rbc129", dict(nx=129, ny=129, ra=1e7, dt=2e-3, periodic=False))]
+    if on_chip:
+        cases += [
+            ("rbc1025", dict(nx=1025, ny=1025, ra=1e9, dt=1e-4, periodic=False)),
+            ("rbc2049", dict(nx=2049, ny=2049, ra=1e9, dt=5e-5, periodic=False)),
+            ("periodic1024", dict(nx=1024, ny=1025, ra=1e9, dt=1e-4, periodic=True)),
+        ]
+    parity_tol = 1e-10 if config.X64 else 1e-3
+    prev_knob = os.environ.get("RUSTPDE_CONV_KERNEL")
+    res = {"configs": {}, "interpret_mode": not on_chip, "parity_tol": parity_tol}
+    ok = True
+    try:
+        for name, c in cases:
+            ctor = Navier2D.new_periodic if c["periodic"] else Navier2D.new_confined
+
+            def build(kernel, c=c, ctor=ctor):
+                os.environ["RUSTPDE_CONV_KERNEL"] = kernel
+                m = ctor(c["nx"], c["ny"], c["ra"], 1.0, c["dt"], 1.0, "rbc")
+                m.set_velocity(0.1, 2.0, 2.0)
+                m.set_temperature(0.1, 2.0, 2.0)
+                return m
+
+            row = {}
+            for kernel in ("dense", "pallas"):
+                m = build(kernel)
+                if kernel == "pallas" and m._conv_impl is None:
+                    raise RuntimeError("pallas conv kernels were not selected")
+                r = benchmark_steps(m, steps)
+                row[kernel] = {
+                    "ms_per_step": r["ms_per_step"],
+                    "steps_per_sec": r["steps_per_sec"],
+                    "mfu": mfu_estimate(m, r["steps_per_sec"])["mfu"],
+                }
+                if kernel == "pallas":
+                    live_pallas = m
+            row["speedup_x"] = (
+                row["dense"]["ms_per_step"] / row["pallas"]["ms_per_step"]
+            )
+            # bit-tolerance leg: fresh models, identical IC, 8 steps.  Each
+            # leaf's deviation is normalized by the larger of its own scale
+            # and the physical-field scale: the pseudo-pressure is ~zero at
+            # near-incompressibility, so its own max is roundoff noise, not
+            # a meaningful denominator
+            d2, p2 = build("dense"), build("pallas")
+            d2.update_n(8)
+            p2.update_n(8)
+            field_scale = max(
+                float(np.abs(np.asarray(b)).max())
+                for b in (d2.state.temp, d2.state.velx, d2.state.vely)
+            )
+            rel = 0.0
+            for a, b in zip(p2.state, d2.state):
+                a, b = np.asarray(a), np.asarray(b)
+                scale = max(float(np.abs(b).max()), field_scale, 1e-30)
+                rel = max(rel, float(np.abs(a - b).max() / scale))
+            row["parity_max_rel"] = rel
+            nu_d, nu_p = d2.eval_nu(), p2.eval_nu()
+            row["nu_rel"] = abs(nu_p - nu_d) / max(1e-12, abs(nu_d))
+            row["parity_ok"] = bool(
+                rel < parity_tol and row["nu_rel"] < parity_tol
+            )
+            # knob flips must not leak recompiles into live models
+            os.environ["RUSTPDE_CONV_KERNEL"] = "dense"
+            before = (live_pallas.recompile_count, d2.recompile_count)
+            live_pallas.update_n(4)
+            os.environ["RUSTPDE_CONV_KERNEL"] = "pallas"
+            d2.update_n(4)
+            row["recompile_flat"] = bool(
+                (live_pallas.recompile_count, d2.recompile_count) == before
+            )
+            ok = ok and row["parity_ok"] and row["recompile_flat"]
+            res["configs"][name] = row
+    finally:
+        if prev_knob is None:
+            os.environ.pop("RUSTPDE_CONV_KERNEL", None)
+        else:
+            os.environ["RUSTPDE_CONV_KERNEL"] = prev_knob
+    head = res["configs"]["rbc129"]
+    res["steps_per_sec"] = head["pallas"]["steps_per_sec"]
+    res["ms_per_step"] = head["pallas"]["ms_per_step"]
+    res["mfu"] = {"mfu": head["pallas"]["mfu"]}
+    res["speedup_x"] = head["speedup_x"]
+    res["parity_max_rel"] = max(
+        r["parity_max_rel"] for r in res["configs"].values()
+    )
+    res["finite"] = bool(ok)
+    return res
+
+
 def bench_resilience(nx, ny, ra, dt, steps):
     """Recovery-overhead config (utils/resilience.py): the same horizon run
     twice — once clean (plain ``integrate``), once under a
@@ -1414,6 +1525,10 @@ def main() -> int:
                 # multi-model campaign rates (dns/lnse/adjoint) + the
                 # parity and onset-sign gates
                 r = bench_workloads(steps=max(8, min(steps, 32)))
+            elif name == "pallasconv":
+                # fused-vs-dense convection A/B: parity + recompile gates
+                # everywhere, speed/MFU deltas honest only on-chip
+                r = bench_pallasconv(steps=max(8, min(steps, 16)))
             elif name == "governor129":
                 # overhead leg slope-times two chains; the spike legs rerun
                 # a capped horizon (governed: at the descended-ladder dt)

@@ -518,6 +518,83 @@ class Base:
         cut[self.m * 2 // 3 :] = 0.0
         return cut
 
+    def axis_operator(self, key, sep: bool = False):
+        """Stable public accessor for the dense per-axis operator matrix in
+        this base's *storage layout* — what the fused-kernel builders
+        (ops/pallas_conv.py, the manual-sharding conv region) consume
+        instead of reaching into the private folding internals.  ``key``
+        uses the `_sep_dev` vocabulary: ``"fwd" | "fwd_cut" | "bwd" |
+        "synthesis" | "stencil" | "proj" | ("bwd_grad", order) |
+        ("grad", order)``.  Returns an
+        :class:`~rustpde_mpi_tpu.ops.folded.AxisOperator`; applying its
+        ``matrix`` with one plain GEMM reproduces the folded/sep device
+        apply exactly up to floating-point reassociation.
+
+        Periodic r2c bases return the SPLIT Re/Im real-matrix form (the only
+        dense-matrix form of the r2c transform); for the complex
+        representation the caller converts at the boundary
+        (``[Re(c); Im(c)]`` stacking, bases.SplitFourierBase.to_complex)."""
+        from .ops.folded import AxisOperator, dense_operator, kept_storage_rows
+
+        if self.kind.is_periodic:
+            if self.kind == BaseKind.FOURIER_C2C:
+                raise ValueError("axis_operator is not defined for c2c bases")
+            if sep:
+                raise ValueError("sep layout is not defined for Fourier axes")
+            m2 = 2 * (self.n // 2 + 1)
+            if key == "fwd":
+                return AxisOperator(fou.split_forward_matrix(self.n), (False, False), None, None)
+            if key == "fwd_cut":
+                # per-complex-mode 2/3 cut applied to the Re and Im blocks
+                # alike (SplitFourierBase.dealias_cut — also the convention
+                # the complex base's dealias_mask follows per mode)
+                mc = self.n // 2 + 1
+                cut = np.ones(m2)
+                cut[mc * 2 // 3 : mc] = 0.0
+                cut[mc + mc * 2 // 3 :] = 0.0
+                mat = fou.split_forward_matrix(self.n) * cut[:, None]
+                return AxisOperator(mat, (False, False), mc * 2 // 3, np.where(cut > 0)[0])
+            if key in ("bwd", "synthesis"):
+                return AxisOperator(fou.split_backward_matrix(self.n), (False, False), None, None)
+            if isinstance(key, tuple) and key[0] == "bwd_grad":
+                mat = fou.split_backward_matrix(self.n) @ fou.split_diff_matrix(self.n, key[1])
+                return AxisOperator(mat, (False, False), None, None)
+            if isinstance(key, tuple) and key[0] == "grad":
+                return AxisOperator(fou.split_diff_matrix(self.n, key[1]), (False, False), None, None)
+            if key in ("stencil", "proj"):
+                return AxisOperator(np.eye(m2), (False, False), None, None)
+            raise ValueError(f"unknown axis_operator key {key!r}")
+        if not self.kind.is_chebyshev:  # pragma: no cover - no other kinds
+            raise ValueError(f"axis_operator undefined for {self.kind}")
+        keep = None
+        if key == "fwd":
+            mat, sin, sout = self.projection @ chb.analysis_matrix(self.n), False, sep
+        elif key == "fwd_cut":
+            mat, sin, sout = self.projection @ chb.analysis_matrix(self.n), False, sep
+            keep = self.m * 2 // 3
+        elif key == "bwd":
+            mat, sin, sout = chb.synthesis_matrix(self.n) @ self.stencil, sep, False
+        elif key == "synthesis":
+            mat, sin, sout = chb.synthesis_matrix(self.n), sep, False
+        elif key == "stencil":
+            mat, sin, sout = self.stencil, sep, sep
+        elif key == "proj":
+            mat, sin, sout = self.projection, sep, sep
+        elif isinstance(key, tuple) and key[0] == "bwd_grad":
+            mat = chb.synthesis_matrix(self.n) @ self.gradient_matrix(key[1])
+            sin, sout = sep, False
+        elif isinstance(key, tuple) and key[0] == "grad":
+            mat, sin, sout = self.gradient_matrix(key[1]), sep, sep
+        else:
+            raise ValueError(f"unknown axis_operator key {key!r}")
+        kept = None if keep is None else kept_storage_rows(mat.shape[0], keep, sout)
+        return AxisOperator(
+            dense_operator(mat, sep_in=sin, sep_out=sout, keep_rows=keep),
+            (sin, sout),
+            keep,
+            kept,
+        )
+
 
 class SplitFourierBase(Base):
     """Real r2c Fourier base in the split Re/Im representation: spectral

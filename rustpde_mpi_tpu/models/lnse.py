@@ -151,7 +151,10 @@ class Navier2DLnse(CampaignModelBase, Integrate):
         )
 
     def _gspmd_split_sep_fallback(self) -> bool:
-        return self.navier._gspmd_split_sep_fallback()
+        # the DNS step routes this layout through manual shard_map regions
+        # (ShardedConv/ShardedPoisson); the LNSE step has no manual
+        # counterpart yet — shared eager-guard policy
+        return self.navier._split_sep_eager_unless_forced()
 
     def _state_example(self):
         nav = self.navier

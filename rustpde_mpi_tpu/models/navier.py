@@ -250,6 +250,12 @@ class Navier2D(CampaignModelBase, Integrate):
         # dealiasing mask over the scratch spectral shape (split-aware)
         self._dealias = jnp.asarray(self.field_space.dealias_mask(), dtype=rdt)
 
+        # fused convection-chain impls keyed by id(space) — FusedConv
+        # (RUSTPDE_CONV_KERNEL=pallas, ops/pallas_conv.py) or ShardedConv
+        # (manual-partitioned split-sep path, parallel/decomp.py); None
+        # keeps the unfused dense chain (the measured default)
+        self._conv_impl = self._build_conv_kernels()
+
         # fused projection-gradient operators for the velocity correction
         # (confined only; the periodic x-axis gradient is diagonal logic):
         # velx -= P_u (D S_q) pseu / sx  per axis — one cross-space matrix
@@ -284,6 +290,104 @@ class Navier2D(CampaignModelBase, Integrate):
     # one-time-warning latch for the GSPMD split-sep fallback (class-level:
     # one warning per process, not per model)
     _warned_split_sep_fallback = False
+
+    def _build_conv_kernels(self):
+        """Fused convection-chain implementations the step's ``conv()``
+        routes through by space identity (None: the unfused dense chain).
+
+        * no mesh + ``RUSTPDE_CONV_KERNEL=pallas``: the VMEM-tiled Pallas
+          kernel (ops/pallas_conv.py; interpreter mode off-TPU);
+        * active mesh on the split-sep periodic layout (default mode
+          "manual"): the manually-partitioned shard_map region
+          (parallel/decomp.ShardedConv) — explicit per-pencil GEMMs +
+          transposes instead of the GSPMD propagation that miscompiles the
+          fused step there;
+        * any other meshed model keeps the dense chain: its convection
+          GEMMs partition cleanly under GSPMD."""
+        from ..ops import pallas_conv
+
+        if self.mesh is not None:
+            if self._split_sep_mode() == "manual":
+                from ..parallel.decomp import (
+                    ShardedConv,
+                    ShardedPoisson,
+                    ShardedSynthesis,
+                )
+
+                specs = {}
+                for space in (self.velx_space, self.temp_space):
+                    if id(space) not in specs:
+                        specs[id(space)] = ShardedConv(
+                            space, self.field_space, self.scale, self.mesh
+                        )
+                # the convection-velocity syntheses ride their own region,
+                # and the pressure-Poisson fast-diag solve — the stage the
+                # miscompile bisects to (see ShardedPoisson) — MUST be
+                # manual for the fused step to compile correctly
+                self._manual_synth = {
+                    id(self.velx_space): ShardedSynthesis(
+                        self.velx_space, None, self.mesh
+                    )
+                }
+                self._manual_poisson = ShardedPoisson(
+                    self.solver_pres, self.pseu_space, self.mesh
+                )
+                return specs
+            self._manual_synth = None
+            self._manual_poisson = None
+            return None
+        self._manual_synth = None
+        self._manual_poisson = None
+        if pallas_conv.conv_kernel_choice() != "pallas":
+            return None
+        return pallas_conv.build_model_convs(self)
+
+    def _split_sep_poisoned(self) -> bool:
+        """The layout the upstream GSPMD bug miscompiles: split Re/Im
+        Fourier x sep Chebyshev under an active mesh (see
+        ``_gspmd_split_sep_fallback``)."""
+        if self.mesh is None or not self.periodic:
+            return False
+        sp = self.temp_space
+        return sp.bases[0].kind.is_split and any(sp.sep)
+
+    def _split_sep_eager_unless_forced(self) -> bool:
+        """Eager-guard policy for wrapper models (Navier2DLnse /
+        Navier2DAdjoint) whose steps have no manual shard_map counterpart
+        yet: per-stage eager whenever the poisoned layout is active, unless
+        ``RUSTPDE_FORCE_FUSED_GSPMD=1`` pins the fused path — ONE shared
+        helper so the two wrappers cannot drift when their manual regions
+        eventually land."""
+        import os
+
+        if os.environ.get("RUSTPDE_FORCE_FUSED_GSPMD") == "1":
+            return False
+        return self._split_sep_poisoned()
+
+    def _split_sep_mode(self) -> str:
+        """How a split-sep periodic model executes under an active mesh:
+
+        * ``"fused"`` — the plain GSPMD-fused step (non-poisoned layouts;
+          or ``RUSTPDE_FORCE_FUSED_GSPMD=1``, which keeps the pinned xfail
+          tracking the upstream miscompile);
+        * ``"manual"`` (default on the poisoned layout) — fused scanned
+          step with the convection transforms in manually-partitioned
+          shard_map regions (ShardedConv): correct AND compiled, retiring
+          the per-stage eager fallback;
+        * ``"eager"`` (``RUSTPDE_SPLIT_SEP_FALLBACK=eager``) — the old
+          per-stage dispatch path, kept for triage A/Bs."""
+        import os
+
+        if os.environ.get("RUSTPDE_FORCE_FUSED_GSPMD") == "1":
+            return "fused"
+        if not self._split_sep_poisoned():
+            return "fused"
+        mode = os.environ.get("RUSTPDE_SPLIT_SEP_FALLBACK", "manual")
+        if mode not in ("manual", "eager"):
+            raise ValueError(
+                f"RUSTPDE_SPLIT_SEP_FALLBACK must be 'manual' or 'eager', got {mode!r}"
+            )
+        return mode
 
     # -- scenario modifiers ---------------------------------------------------
 
@@ -423,23 +527,19 @@ class Navier2D(CampaignModelBase, Integrate):
         super()._compile_eager_entry_points()
 
     def _gspmd_split_sep_fallback(self) -> bool:
-        """True when the FUSED jitted step would be miscompiled: GSPMD
+        """True when the step must run the per-stage EAGER path.  GSPMD
         miscompiles the fused split-sep periodic step under an active mesh
         (container jax 0.4.37 regression — every stage matches serial to
         ~1e-17 jitted separately and the eager per-op sharded step is exact,
-        but the fused program yields wrong vely/pres from step 1; xfailed
-        with bisection evidence in tests/test_parallel.py).  Until upstream
-        is fixed, such models run the per-stage eager path: slow but right.
-        ``RUSTPDE_FORCE_FUSED_GSPMD=1`` forces the fused path anyway (for
-        upstream triage / once a fixed jax lands)."""
-        import os
-
-        if os.environ.get("RUSTPDE_FORCE_FUSED_GSPMD") == "1":
-            return False
-        if self.mesh is None or not self.periodic:
-            return False
-        sp = self.temp_space
-        return sp.bases[0].kind.is_split and any(sp.sep)
+        but the fused program yields wrong vely/pres from step 1; pinned
+        xfail in tests/test_parallel.py under RUSTPDE_FORCE_FUSED_GSPMD=1).
+        The DEFAULT on that layout is no longer eager: the convection
+        transforms run as manually-partitioned shard_map regions
+        (``_split_sep_mode() == "manual"``, parallel/decomp.ShardedConv),
+        which sidesteps the broken SPMD propagation by construction and
+        keeps the fused scanned chunk — eager remains only as the
+        ``RUSTPDE_SPLIT_SEP_FALLBACK=eager`` triage pin."""
+        return self._split_sep_mode() == "eager"
 
     def _compat_fields(self) -> tuple:
         """Everything (beyond the kind prefix) baked into the model's
@@ -677,6 +777,10 @@ class Navier2D(CampaignModelBase, Integrate):
 
             return contextlib.nullcontext()
 
+        conv_impl = self._conv_impl
+        manual_synth = getattr(self, "_manual_synth", None)
+        manual_poisson = getattr(self, "_manual_poisson", None)
+
         def conv(ux, uy, space, vhat, with_bc=False):
             """u . grad(v), dealiased, in scratch-ortho space
             (/root/reference/src/navier_stokes/functions.rs:56-69 +
@@ -687,6 +791,16 @@ class Navier2D(CampaignModelBase, Integrate):
             for the whole step at 1025^2 f32 (4.01 vs 3.41 ms) — inside one
             compiled program the extra stack/unstack HBM copies and the
             batched dot_generals cost more than the saved op count."""
+            if conv_impl is not None:
+                # the whole chain as one fused region: the Pallas VMEM
+                # kernel (physical intermediates never touch HBM, dealias
+                # row-drop in the epilogue) or the manually-partitioned
+                # shard_map region on the split-sep mesh layout — both
+                # exact to the chain below at fp reassociation
+                fc = conv_impl[id(space)]
+                if with_bc:
+                    return fc.apply(ux, uy, vhat, tb_dx, tb_dy)
+                return fc.apply(ux, uy, vhat)
             # fused synthesis-of-derivative: one GEMM per axis on sep spaces
             # (Space2.backward_gradient == backward_ortho(gradient(.)));
             # fast=True: 3-pass synthesis for the dealiased products
@@ -722,9 +836,15 @@ class Navier2D(CampaignModelBase, Integrate):
             # buoyancy (full ortho space, includes the lift field)
             that = sp_t.to_ortho(temp) + tb_ortho
             # convection velocity in physical space (old time level; fast
-            # 3-pass synthesis — feeds only the dealiased products)
-            ux = sp_u.backward_fast(velx)
-            uy = sp_v.backward_fast(vely)
+            # 3-pass synthesis — feeds only the dealiased products); the
+            # manual split-sep path runs these through their own shard_map
+            # region (decomp.ShardedSynthesis)
+            if manual_synth is not None:
+                ux = manual_synth[id(sp_u)].apply(velx)
+                uy = manual_synth[id(sp_v)].apply(vely)
+            else:
+                ux = sp_u.backward_fast(velx)
+                uy = sp_v.backward_fast(vely)
 
             if with_sentinels:
                 # sentinels of the consumed state, from the velocities the
@@ -763,7 +883,13 @@ class Navier2D(CampaignModelBase, Integrate):
                 vely_n, (0, 1), scale
             )
             with solve_scope():
-                pseu_n = sol_p.solve(pin(div))
+                if manual_poisson is not None:
+                    # the manually-partitioned fast-diag region — the one
+                    # stage whose GSPMD fusion miscompiles on the split-sep
+                    # layout (parallel/decomp.ShardedPoisson bisection)
+                    pseu_n = manual_poisson.solve(div)
+                else:
+                    pseu_n = sol_p.solve(pin(div))
             pseu_n = sp_q.pin_zero_mode(pseu_n)  # remove singularity
             if proj_grad is not None:
                 gx0, gx1, gy0, gy1 = proj_grad

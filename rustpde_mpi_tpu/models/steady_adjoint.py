@@ -143,7 +143,9 @@ class Navier2DAdjoint(CampaignModelBase, Integrate):
         )
 
     def _gspmd_split_sep_fallback(self) -> bool:
-        return self.navier._gspmd_split_sep_fallback()
+        # like Navier2DLnse: no manual shard_map counterpart for the
+        # adjoint step yet — shared eager-guard policy
+        return self.navier._split_sep_eager_unless_forced()
 
     def restart_fill(self, name: str, like):
         """Gathered-restore fill: residual norms restart at +inf (unknown —

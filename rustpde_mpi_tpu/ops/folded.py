@@ -25,6 +25,7 @@ Enable/disable with RUSTPDE_FOLDED (default on).
 from __future__ import annotations
 
 import os
+from typing import NamedTuple
 
 import jax
 import jax.numpy as jnp
@@ -66,6 +67,74 @@ def folding_enabled() -> bool:
 def parity_perm(m: int) -> np.ndarray:
     """Natural -> sep order: position p holds natural index perm[p]."""
     return np.concatenate([np.arange(0, m, 2), np.arange(1, m, 2)])
+
+
+class AxisOperator(NamedTuple):
+    """One per-axis transform operator in its *storage layout* — the stable
+    accessor contract the fused-kernel builders consume (ops/pallas_conv.py,
+    the manual-sharding conv region in parallel/decomp.py) instead of
+    reaching into the private folding internals above.
+
+    * ``matrix`` — dense host matrix equal, element for element, to what the
+      folded/sep device applies compute: sep permutations baked into the
+      rows/columns, dealias-dead output rows zeroed.  Applying it with one
+      plain GEMM reproduces the folded apply exactly up to floating-point
+      reassociation (the folds are lossless).
+    * ``parity`` — ``(sep_in, sep_out)``: which sides are stored in the
+      parity-separated order (ops/folded.py sep layout).
+    * ``dealias_rows`` — number of kept NATURAL-order output rows under the
+      2/3-rule cut (None: no cut baked in).
+    * ``kept_rows`` — storage-layout indices of the rows that stay nonzero
+      under the cut (None: all rows); the contiguous-run structure a kernel
+      epilogue uses to drop the dead rows from its GEMM and zero-fill the
+      output."""
+
+    matrix: np.ndarray
+    parity: tuple
+    dealias_rows: int | None
+    kept_rows: np.ndarray | None
+
+
+def dense_operator(
+    mat: np.ndarray,
+    sep_in: bool = False,
+    sep_out: bool = False,
+    keep_rows: int | None = None,
+) -> np.ndarray:
+    """The dense storage-layout matrix equivalent to
+    ``FoldedMatrix(mat, sep_in=…, sep_out=…, keep_rows=…)`` — THE single
+    source of truth for how the sep layout permutes operator matrices (the
+    same conjugation `_detect_sep` applies to unstructured fallbacks).
+    Dead dealias rows are zeroed in NATURAL order before any permutation,
+    exactly like the ``keep_rows`` row-drop of `_AnalysisSep`."""
+    mat = np.asarray(mat)
+    r, c = mat.shape
+    if keep_rows is not None and keep_rows < r:
+        mat = np.where(np.arange(r)[:, None] < max(0, keep_rows), mat, 0.0)
+    if sep_out:
+        mat = mat[parity_perm(r), :]
+    if sep_in:
+        mat = mat[:, parity_perm(c)]
+    return mat
+
+
+def pad_dense(mat: np.ndarray, rows: int, cols: int) -> np.ndarray:
+    """Zero-pad a host operator matrix to ``(rows, cols)`` — the one shared
+    tile-padding helper of the fused-kernel builders (zero rows/columns are
+    mathematically inert through the linear chains)."""
+    mat = np.asarray(mat)
+    out = np.zeros((rows, cols), dtype=mat.dtype)
+    out[: mat.shape[0], : mat.shape[1]] = mat
+    return out
+
+
+def kept_storage_rows(r: int, keep_rows: int, sep_out: bool) -> np.ndarray:
+    """Storage-layout row indices that survive a ``keep_rows`` natural-order
+    prefix cut: ``arange(keep_rows)`` in natural order; under the sep
+    permutation the kept rows form one contiguous run per parity block."""
+    if not sep_out:
+        return np.arange(max(0, min(keep_rows, r)))
+    return np.where(parity_perm(r) < keep_rows)[0]
 
 
 def parity_perm_inv(m: int) -> np.ndarray:

@@ -84,6 +84,32 @@ def split_backward_matrix(n: int) -> np.ndarray:
     return B
 
 
+def split_diff_matrix(n: int, order: int) -> np.ndarray:
+    """(2m x 2m) real matrix of ``(ik)^order`` on the split Re/Im blocks —
+    the dense form of :meth:`~rustpde_mpi_tpu.bases.SplitFourierBase.gradient`'s
+    block rotation (``i^order`` cycles (re, im) through the four quadrants,
+    times ``k^order``; Nyquist of odd derivatives zeroed exactly like
+    :func:`diff_diag`).  Consumed by the fused-kernel builders, which need
+    the derivative as a matrix to compose with the synthesis."""
+    m = n // 2 + 1
+    k = wavenumbers_r2c(n) ** order
+    if order % 2 == 1 and n % 2 == 0:
+        k = k.copy()
+        k[-1] = 0.0
+    K = np.diag(k)
+    Z = np.zeros((m, m))
+    quadrant = order % 4
+    if quadrant == 0:
+        blocks = [[K, Z], [Z, K]]
+    elif quadrant == 1:
+        blocks = [[Z, -K], [K, Z]]
+    elif quadrant == 2:
+        blocks = [[-K, Z], [Z, -K]]
+    else:
+        blocks = [[Z, K], [-K, Z]]
+    return np.block(blocks)
+
+
 def dft_cos_matrix(n: int) -> np.ndarray:
     """(n x n) matrix ``cos(2pi k j / n)`` with both the row and the column
     mirror (k -> n-k, j -> n-j) exact by construction — the quarter-fold

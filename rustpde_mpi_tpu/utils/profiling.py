@@ -172,13 +172,53 @@ PEAK_FLOPS = {
 }
 
 
+#: analytic per-invocation flops of named Pallas kernels
+#: (:func:`register_pallas_flops`): the jaxpr walk below sees a
+#: ``pallas_call`` as ONE opaque eqn, so without this the MFU numbers
+#: (serve_mfu gauge, bench rows) silently under-report on kernel paths.
+#: Primary accounting recurses into the kernel jaxpr and multiplies by the
+#: grid size (exact for GEMM kernels); the registry overrides by kernel
+#: name for kernels whose body the walk cannot price (DMA/collective
+#: kernels, recurrences whose flops are not dot_generals).
+PALLAS_FLOPS: dict[str, float] = {}
+
+
+def register_pallas_flops(name: str, flops: float) -> None:
+    """Register the analytic flops of one invocation of the Pallas kernel
+    dispatched under ``name`` (the ``pallas_call`` name) — kernels with
+    shape-dependent cost should re-register at build time (last value
+    wins; ops/pallas_conv.build_model_convs does)."""
+    PALLAS_FLOPS[name] = float(flops)
+
+
+def _pallas_eqn_flops(eqn) -> float:
+    """Flops of one ``pallas_call`` eqn: registry by kernel name first, else
+    the kernel-body dot count times the grid size."""
+    import math
+
+    name = getattr(eqn.params.get("name_and_src_info"), "name", None)
+    if name in PALLAS_FLOPS:
+        return PALLAS_FLOPS[name]
+    grid_mapping = eqn.params.get("grid_mapping")
+    grid = math.prod(getattr(grid_mapping, "grid", ()) or (1,))
+    inner = eqn.params.get("jaxpr")
+    if inner is not None and hasattr(inner, "eqns"):
+        return grid * _jaxpr_dot_flops(inner)
+    return 0.0
+
+
 def _jaxpr_dot_flops(jaxpr) -> float:
     """Exact MXU flops of a jaxpr: walk every dot_general (recursing into
-    scan/cond/pjit sub-jaxprs) and sum 2*batch*M*N*K from the operand shapes."""
+    scan/cond/pjit sub-jaxprs) and sum 2*batch*M*N*K from the operand
+    shapes; ``pallas_call`` bodies are priced via :func:`_pallas_eqn_flops`
+    (grid-scaled kernel dot count, registry override)."""
     import math
 
     total = 0.0
     for eqn in jaxpr.eqns:
+        if eqn.primitive.name == "pallas_call":
+            total += _pallas_eqn_flops(eqn)
+            continue
         if eqn.primitive.name == "dot_general":
             a = eqn.invars[0].aval
             b = eqn.invars[1].aval

@@ -112,6 +112,10 @@ def main() -> int:
         # recorded into PARITY.json too, so cross-model vmap/scan drift
         # shows up per-PR next to the Nu-parity numbers
         "workloads": _workloads_parity(),
+        # fused-Pallas-vs-dense convection parity per layout (max rel diff,
+        # interpreter mode) — merged into PARITY.json under "pallas_conv"
+        # so kernel drift is tracked per-PR like the Nu trajectories
+        "pallas_conv": _pallas_conv_parity(),
         # telemetry inventory (METRICS.json written alongside): the metric
         # names an instrumented run registers — a per-PR record of the
         # observable vocabulary, like the journal schema rows
@@ -258,6 +262,84 @@ def _workloads_parity() -> dict | None:
     except (OSError, ValueError):
         parity = {}
     parity["workloads"] = payload
+    tmp = f"{parity_path}.{os.getpid()}.tmp"
+    with open(tmp, "w") as f:
+        json.dump(parity, f, indent=1)
+    os.replace(tmp, parity_path)
+    return payload
+
+
+_PALLAS_CONV_CHILD = r"""
+import json, os, sys
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.setdefault("RUSTPDE_X64", "1")
+sys.path.insert(0, %(repo)r)
+import numpy as np
+import jax
+jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
+from rustpde_mpi_tpu.bases import (
+    Space2, cheb_dirichlet, chebyshev, fourier_r2c, fourier_r2c_split,
+)
+from rustpde_mpi_tpu.ops.pallas_conv import FusedConv
+
+def delta(sp, fs, seed=0):
+    fc = FusedConv(sp, fs, (1.0, 1.0))
+    rng = np.random.default_rng(seed)
+    nx, ny = sp.shape_physical
+    ux = jnp.asarray(rng.standard_normal((nx, ny)))
+    uy = jnp.asarray(rng.standard_normal((nx, ny)))
+    vhat = sp.forward(jnp.asarray(rng.standard_normal((nx, ny))))
+    ref = np.asarray(fc.reference(ux, uy, vhat))
+    out = np.asarray(fc.apply(ux, uy, vhat))
+    return float(np.abs(out - ref).max() / (np.abs(ref).max() or 1.0))
+
+os.environ["RUSTPDE_SEP"] = "1"
+deltas = {
+    "confined_sep": delta(
+        Space2(cheb_dirichlet(33), cheb_dirichlet(33), method="matmul", sep=True),
+        Space2(chebyshev(33), chebyshev(33), method="matmul", sep=True),
+    ),
+    "periodic_complex": delta(
+        Space2(fourier_r2c(16), cheb_dirichlet(17)),
+        Space2(fourier_r2c(16), chebyshev(17)),
+    ),
+    "split_sep": delta(
+        Space2(fourier_r2c_split(16), cheb_dirichlet(17), method="matmul"),
+        Space2(fourier_r2c_split(16), chebyshev(17), method="matmul"),
+    ),
+}
+print("PALLAS_CONV_JSON " + json.dumps(deltas))
+"""
+
+
+def _pallas_conv_parity() -> dict | None:
+    """Max relative dense-vs-Pallas deviation of the fused convection chain
+    per layout (CPU interpreter mode, f64), merged into PARITY.json under
+    ``"pallas_conv"``.  Best-effort like the workloads probe."""
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", _PALLAS_CONV_CHILD % {"repo": _REPO}],
+            capture_output=True,
+            text=True,
+            timeout=600,
+            cwd=_REPO,
+        )
+        line = next(
+            ln for ln in proc.stdout.splitlines()
+            if ln.startswith("PALLAS_CONV_JSON ")
+        )
+        deltas = json.loads(line[len("PALLAS_CONV_JSON "):])
+    except Exception as exc:  # noqa: BLE001 — recording must not fail the run
+        return {"error": f"{type(exc).__name__}: {exc}"}
+    payload = {"max_rel_diff": deltas, "date": _utc_now()}
+    parity_path = os.path.join(_REPO, "PARITY.json")
+    try:
+        with open(parity_path) as f:
+            parity = json.load(f)
+    except (OSError, ValueError):
+        parity = {}
+    parity["pallas_conv"] = payload
     tmp = f"{parity_path}.{os.getpid()}.tmp"
     with open(tmp, "w") as f:
         json.dump(parity, f, indent=1)
