@@ -392,6 +392,53 @@ def bench_governor(nx, ny, ra, dt, steps):
             shutil.rmtree(d, ignore_errors=True)
     tel_ok = bool(tel_overhead <= 0.02)
 
+    # collective-sequence sanitizer overhead gate (PR 12): RUSTPDE_SANITIZE
+    # armed vs off through the identical runner advance path (the per-
+    # boundary root_decides handshakes are the recorded collective entry
+    # points on a single process), same matched-window min-of-reps shape as
+    # the telemetry leg.  Gates: <=2% wall overhead armed AND bit-equal
+    # observables (the sanitizer is host-side only — it must never perturb
+    # the traced programs).
+    from rustpde_mpi_tpu.parallel import sanitizer as _sanitizer
+
+    san_dirs = [tempfile.mkdtemp(prefix="bench_san_") for _ in range(2)]
+    try:
+        runners = {}
+        for key, d in (("on", san_dirs[0]), ("off", san_dirs[1])):
+            runners[key] = _Runner(
+                build(StabilityConfig()),
+                max_time=float("inf"),
+                run_dir=d,
+                checkpoint_every_s=None,
+                max_chunk_steps=L,
+            )
+        san_prev = _sanitizer.enabled()
+        san_walls = {"on": [], "off": []}
+        try:
+            for key, r in runners.items():  # compile + warm the chunk shapes
+                _sanitizer.set_enabled(key == "on")
+                r.advance(tel_window)
+                _jax.block_until_ready(r.pde.state)
+            for _ in range(5):
+                for key, r in runners.items():
+                    _sanitizer.set_enabled(key == "on")
+                    t0 = time.perf_counter()
+                    r.advance(tel_window)
+                    _jax.block_until_ready(r.pde.state)
+                    san_walls[key].append(time.perf_counter() - t0)
+        finally:
+            _sanitizer.set_enabled(san_prev)
+        san_overhead = min(san_walls["on"]) / min(san_walls["off"]) - 1.0
+        san_records = _sanitizer.stats()["records"]
+        nu_on = float(runners["on"].pde.eval_nu())
+        nu_off = float(runners["off"].pde.eval_nu())
+        san_bit_equal = bool(nu_on == nu_off)
+    finally:
+        for d in san_dirs:
+            shutil.rmtree(d, ignore_errors=True)
+    # the armed leg must have RECORDED something, or the gate is vacuous
+    san_ok = bool(san_overhead <= 0.02 and san_records > 0)
+
     # probe the CFL the flow will have AT the spike step (the early flow is
     # far calmer than the developed one the overhead window ends in), then
     # size the spike WITH MARGIN — 8x the ceiling, not a value that lands
@@ -489,6 +536,10 @@ def bench_governor(nx, ny, ra, dt, steps):
         "telemetry_overhead_x": 1.0 + tel_overhead,
         "telemetry_overhead_ok": tel_ok,
         "telemetry_bit_equal": tel_bit_equal,
+        "sanitizer_overhead_x": 1.0 + san_overhead,
+        "sanitizer_overhead_ok": san_ok,
+        "sanitizer_records": san_records,
+        "sanitizer_bit_equal": san_bit_equal,
         "cfl_base": cfl_base,
         "spike_factor": spike_factor,
         "governed_retries": g_summary["retries"],
@@ -509,6 +560,8 @@ def bench_governor(nx, ny, ra, dt, steps):
             and overhead_ok
             and tel_ok
             and tel_bit_equal
+            and san_ok
+            and san_bit_equal
         ),
     }
 
@@ -855,6 +908,10 @@ def bench_serve(nx=129, ny=129, ra=1e7, dt=2e-3, steps_per_req=8):
                 "RUSTPDE_MP_SERVE_REQUESTS": str(mp_req),
                 "RUSTPDE_SYNC_TIMEOUT_S": "60",
                 "RUSTPDE_DISPATCH_TIMEOUT_S": "60",
+                # collective-sequence sanitizer armed through the whole mp
+                # leg (drain + grown-fleet restart): the run only passes if
+                # every host executed the identical collective sequence
+                "RUSTPDE_SANITIZE": "1",
             }
             t0 = time.perf_counter()
             outs = spawn_cluster(
@@ -883,6 +940,7 @@ def bench_serve(nx=129, ny=129, ra=1e7, dt=2e-3, steps_per_req=8):
                 "replans": mp_r["replanned"],
                 "dt_adjusts": mp_r["dt_adjusts"],
                 "restored_mid_trajectory": mp_r["restored_sched"],
+                "sanitizer": mp_r.get("sanitizer"),
                 "wall_s": round(mp_wall, 1),
                 "zero_lost": mp_r["queue"]["queued"] == 0
                 and mp_r["queue"]["running"] == 0
@@ -890,6 +948,12 @@ def bench_serve(nx=129, ny=129, ra=1e7, dt=2e-3, steps_per_req=8):
                 and mp_r["queue"]["done"] == mp_req,
                 "drained_then_replanned": mp_r["drains"] >= 1
                 and mp_r["replanned"] >= 1,
+                # armed AND recorded AND zero desync trips across the leg
+                "sanitizer_clean": bool(
+                    (mp_r.get("sanitizer") or {}).get("enabled")
+                    and (mp_r.get("sanitizer") or {}).get("records", 0) > 0
+                    and (mp_r.get("sanitizer") or {}).get("desyncs", 1) == 0
+                ),
             }
         except Exception as exc:  # noqa: BLE001 — mp leg must not kill the soak
             mp = {"error": f"{type(exc).__name__}: {exc}"}
@@ -949,11 +1013,18 @@ def bench_serve(nx=129, ny=129, ra=1e7, dt=2e-3, steps_per_req=8):
                     if "error" in mp
                     else bool(mp.get("drained_then_replanned"))
                 ),
+                "mp_sanitizer_clean": (
+                    None if "error" in mp else bool(mp.get("sanitizer_clean"))
+                ),
             },
             "finite": all(gates.values())
             and (
                 "error" in mp
-                or bool(mp.get("zero_lost") and mp.get("drained_then_replanned"))
+                or bool(
+                    mp.get("zero_lost")
+                    and mp.get("drained_then_replanned")
+                    and mp.get("sanitizer_clean")
+                )
             ),
         }
     finally:

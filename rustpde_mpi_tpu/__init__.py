@@ -69,6 +69,7 @@ from .telemetry import (  # noqa: F401
     MetricsRegistry,
     ThroughputMonitor,
 )
+from .parallel.sanitizer import CollectiveDesyncError  # noqa: F401
 from .utils.checkpoint import CheckpointError  # noqa: F401
 from .utils.faults import FaultSpecError  # noqa: F401
 from .utils.resilience import (  # noqa: F401

@@ -18,7 +18,6 @@ plain arrays.
 from __future__ import annotations
 
 import enum
-import os
 from functools import cached_property
 
 import jax
@@ -64,8 +63,8 @@ class BaseKind(enum.Enum):
         return self == BaseKind.FOURIER_R2C_SPLIT
 
 
-_FAST_DERIV = os.environ.get("RUSTPDE_FAST_DERIV", "auto")
-_FAST_DERIV_MIN = int(os.environ.get("RUSTPDE_FAST_DERIV_MIN", "2048"))
+_FAST_DERIV = config.env_get("RUSTPDE_FAST_DERIV", "auto")
+_FAST_DERIV_MIN = int(config.env_get("RUSTPDE_FAST_DERIV_MIN", "2048"))
 
 
 def _fast_deriv_enabled(n: int, sep: bool = False) -> bool:
@@ -273,11 +272,11 @@ class Base:
                 # OFF (highest): unlike the syntheses it writes the solve
                 # rhs directly, so the downgrade ships only once measured
                 # on-chip + shadow-gated (RUSTPDE_FWD_PRECISION=high to A/B)
-                env = os.environ.get("RUSTPDE_FWD_PRECISION", "highest")
+                env = config.env_get("RUSTPDE_FWD_PRECISION", "highest")
             else:
-                env = os.environ.get("RUSTPDE_SYNTH_PRECISION", "high")
+                env = config.env_get("RUSTPDE_SYNTH_PRECISION", "high")
             synth_prec = None if env in ("", "highest") else env
-        elif fast and config.X64 and os.environ.get("RUSTPDE_F64_HYBRID") == "1":
+        elif fast and config.X64 and config.env_get("RUSTPDE_F64_HYBRID") == "1":
             # f64-hybrid (SURVEY S7 / VERDICT r4 next #3b): the convection
             # transforms — the step's fast keys, nothing else — run as f32
             # GEMMs (device matrices stored f32, inputs cast in, outputs cast
@@ -338,12 +337,10 @@ class Base:
                 sep_out=True,
                 cast=cast,
             )
-        if synth_prec:
-            # only impls that declare the hook honor an override (the
-            # _SynthesisSep family); unstructured _Plain fallbacks stay at
-            # session precision rather than silently carrying a dead attr
-            if hasattr(type(fm._impl), "precision"):
-                fm._impl.precision = synth_prec
+        # only impls that declare the hook honor an override (the
+        # _SynthesisSep family); unstructured _Plain fallbacks stay at
+        # session precision rather than silently carrying a dead attr
+        fm.set_precision(synth_prec)
         cache[key] = fm
         return cache[key]
 
@@ -826,7 +823,7 @@ class Space2:
         # measured to win); True/False force.  Per-axis: only Chebyshev-
         # family axes separate (split-Fourier axes keep their layout).
         if sep is None:
-            env = os.environ.get("RUSTPDE_SEP", "auto")
+            env = config.env_get("RUSTPDE_SEP", "auto")
             if env == "auto":
                 sep = method == "matmul" and all(
                     b.kind.is_chebyshev for b in self.bases

@@ -15,11 +15,167 @@ choice here:
 from __future__ import annotations
 
 import os
+from dataclasses import dataclass, field
+
+
+# -- Environment knob registry -------------------------------------------------
+#
+# Every ``RUSTPDE_*`` environment knob in the repo is declared HERE, once,
+# with its default and one line of documentation.  Library modules read
+# knobs through :func:`env_get` (which refuses unregistered names), the
+# README "Environment knobs" table mirrors this registry, and
+# tests/test_lint.py diffs all three against a grep of the source tree —
+# so a new knob cannot ship unregistered or undocumented, and a typo'd
+# read dies loudly instead of silently returning the default forever.
+# Driver-side code (bench.py, scripts/, tests/, examples/) may keep raw
+# ``os.environ`` reads, but its knob NAMES must still be registered
+# (scope "bench"/"test"); tools/lint rule RPD006 enforces the read-path
+# rule inside the package (utils/faults.py stays raw by design: it must
+# not import this jax-loading module from inside the two-phase commit
+# window).
+
+
+@dataclass(frozen=True)
+class EnvKnob:
+    """One registered environment knob: ``default`` is documentation of the
+    effective default (None = unset means off/auto), ``scope`` names the
+    consuming layer (``lib`` | ``bench`` | ``test``)."""
+
+    name: str
+    default: str | None
+    doc: str
+    scope: str = "lib"
+
+
+_ENV_KNOBS: dict[str, EnvKnob] = {}
+
+
+class UnregisteredKnobError(KeyError):
+    """A ``RUSTPDE_*`` environment variable was read through
+    :func:`env_get` without being declared in the knob registry."""
+
+
+def register_knob(name: str, default: str | None, doc: str, scope: str = "lib") -> None:
+    _ENV_KNOBS[name] = EnvKnob(name=name, default=default, doc=doc, scope=scope)
+
+
+def env_knobs() -> dict[str, EnvKnob]:
+    """The full knob registry (name -> :class:`EnvKnob`), a copy."""
+    return dict(_ENV_KNOBS)
+
+
+def env_get(name: str, default: str | None = None) -> str | None:
+    """``os.environ.get`` with a registration gate: reading an unregistered
+    ``RUSTPDE_*`` name raises :class:`UnregisteredKnobError` (a typo'd knob
+    must die loudly, not silently read its default forever).  The
+    ``default`` argument keeps call-site semantics — the registry default
+    is documentation, not a fallback."""
+    if name.startswith("RUSTPDE_") and name not in _ENV_KNOBS:
+        raise UnregisteredKnobError(
+            f"environment knob {name!r} is not registered in "
+            "config.env_knobs() — declare it with config.register_knob"
+        )
+    return os.environ.get(name, default)
+
+
+# precision / numerics
+register_knob("RUSTPDE_X64", "1", "f64 master switch (0 = f32 throughput mode)")
+register_knob("RUSTPDE_MATMUL_PRECISION", "highest",
+              "global jax matmul precision (high = 3-pass bf16 on TPU)")
+register_knob("RUSTPDE_FWD_PRECISION", "highest",
+              "dealiased convection forward-transform matmul precision")
+register_knob("RUSTPDE_SYNTH_PRECISION", "high",
+              "synthesis (spectral->physical) matmul precision")
+register_knob("RUSTPDE_SOLVE_PRECISION", None,
+              "scoped matmul precision around the four implicit solves")
+register_knob("RUSTPDE_F64_HYBRID", None,
+              "1 = f32 convection transforms feeding f64 solves under X64")
+# operator / kernel selection
+register_knob("RUSTPDE_FORCE_TPU_PATH", None,
+              "1 = exercise the TPU execution paths on CPU CI")
+register_knob("RUSTPDE_SEP", "auto", "separable y-operator application mode")
+register_knob("RUSTPDE_FOLDED", "1", "folded (kept-row) operator storage")
+register_knob("RUSTPDE_FOURSTEP", "auto", "four-step factored transform mode")
+register_knob("RUSTPDE_FOURSTEP_MIN", "2048", "four-step min size (dft)")
+register_knob("RUSTPDE_FOURSTEP_MIN_C2C", "1024", "four-step min size (c2c)")
+register_knob("RUSTPDE_FOURSTEP_MIN_DCT", "8192", "four-step min size (dct)")
+register_knob("RUSTPDE_FOURSTEP_N1", None, "forced four-step N1 split factor")
+register_knob("RUSTPDE_FAST_DERIV", "auto", "banded fast-derivative mode")
+register_knob("RUSTPDE_FAST_DERIV_MIN", "2048", "fast-derivative min size")
+register_knob("RUSTPDE_CONV_KERNEL", "dense",
+              "convection chain: dense per-GEMM chain | pallas fused kernel")
+register_knob("RUSTPDE_PALLAS_CONV_BLOCK", "256",
+              "pallas conv kernel physical-x tile")
+register_knob("RUSTPDE_PALLAS_CONV_BLOCK_K", "512",
+              "pallas conv kernel spectral-y contraction tile")
+register_knob("RUSTPDE_TRANSPOSE", "alltoall",
+              "pencil transpose collective: alltoall | ring")
+register_knob("RUSTPDE_RING_IMPL", "pallas",
+              "ring transpose implementation: pallas remote-copy | ppermute")
+register_knob("RUSTPDE_SPLIT_SEP_FALLBACK", "manual",
+              "split-sep periodic under a mesh: manual shard_map | eager triage")
+register_knob("RUSTPDE_FORCE_FUSED_GSPMD", None,
+              "1 = pin the known-miscompiling fused GSPMD split-sep path")
+# telemetry
+register_knob("RUSTPDE_TELEMETRY", "1", "telemetry master switch")
+register_knob("RUSTPDE_TRACE", "1", "flight-recorder span tracing switch")
+register_knob("RUSTPDE_TRACE_EVENTS", "4096", "flight-recorder ring capacity")
+register_knob("RUSTPDE_METRICS_DUMP_S", "60", "metrics.jsonl dump cadence")
+# resilience / watchdogs / fault injection
+register_knob("RUSTPDE_DISPATCH_TIMEOUT_S", None, "device-dispatch hang watchdog")
+register_knob("RUSTPDE_SYNC_TIMEOUT_S", "0",
+              "barrier/broadcast watchdog (0 = off): peer death -> DispatchHang")
+register_knob("RUSTPDE_IO_TIMEOUT_S", None, "async checkpoint writer watchdog")
+register_knob("RUSTPDE_FAULT", None,
+              "fault injection <nan|spike|kill|slow>@<step>[:host<p>]")
+register_knob("RUSTPDE_SHARD_CRASH", None,
+              "two-phase commit window kill <after_shard|before_manifest>@<step>[:host<p>]")
+register_knob("RUSTPDE_SPIKE_FACTOR", None, "spike fault velocity scale override")
+# collective-sequence sanitizer (parallel/sanitizer.py)
+register_knob("RUSTPDE_SANITIZE", "0",
+              "1 = record every multihost collective + cadenced cross-host "
+              "sequence verification (CollectiveDesyncError on divergence)")
+register_knob("RUSTPDE_SANITIZE_CADENCE", "32",
+              "collectives between cross-host sequence verifications")
+register_knob("RUSTPDE_SANITIZE_RING", "256",
+              "sanitizer per-host ring capacity (records kept for diagnosis)")
+register_knob("RUSTPDE_SANITIZE_INJECT", None,
+              "desync injection skip_broadcast@<n>[:host<p>] (tests only)")
+# bench drivers (bench.py — raw reads allowed, names registered)
+register_knob("RUSTPDE_BENCH_CONFIGS", None, "comma list of bench configs", "bench")
+register_knob("RUSTPDE_BENCH_STEPS", None, "bench step-count override", "bench")
+register_knob("RUSTPDE_BENCH_BUDGET_S", None, "bench wall budget", "bench")
+register_knob("RUSTPDE_BENCH_SLACK_S", None, "bench budget slack", "bench")
+register_knob("RUSTPDE_BENCH_CHILD", None, "internal: marks a bench child", "bench")
+register_knob("RUSTPDE_BENCH_STARVE_LIMIT", "3",
+              "consecutive budget-starved skips before a config FAILS", "bench")
+register_knob("RUSTPDE_BENCH_PROBE_TIMEOUT_S", None, "device probe timeout", "bench")
+register_knob("RUSTPDE_BENCH_ALLOW_CPU", None, "1 = let bench run on CPU", "bench")
+register_knob("RUSTPDE_BENCH_SHARDED_N", "130",
+              "shardedio129 grid size override", "bench")
+register_knob("RUSTPDE_SERVE_BENCH_REQUESTS", None,
+              "serve129 soak request count", "bench")
+register_knob("RUSTPDE_SERVE_MP_REQUESTS", "4",
+              "serve129 2-proc leg request count", "bench")
+# test harness (tests/ — raw reads allowed, names registered)
+register_knob("RUSTPDE_SLOW", None, "1 = run the slow test tier", "test")
+register_knob("RUSTPDE_TEST_BUDGET_S", "45", "per-test wall budget (fast tier)", "test")
+register_knob("RUSTPDE_TEST_TRACEBACK_S", None,
+              "faulthandler dump_traceback_later arming", "test")
+register_knob("RUSTPDE_MP_BLOCKING_IO", None,
+              "1 = pin synchronous shard writes in mp workers", "test")
+register_knob("RUSTPDE_MP_SERVE_REQUESTS", "5",
+              "mp_worker serve_campaign request count", "test")
+register_knob("RUSTPDE_MP_SERVE_SLOTS", "2",
+              "mp_worker serve_campaign slot count", "test")
+register_knob("RUSTPDE_SERVE_SOAK_REQUESTS", None,
+              "serve chaos soak request count", "test")
+
 
 import jax
 import numpy as np
 
-X64: bool = os.environ.get("RUSTPDE_X64", "1") != "0"
+X64: bool = env_get("RUSTPDE_X64", "1") != "0"
 
 if X64:
     jax.config.update("jax_enable_x64", True)
@@ -30,7 +186,7 @@ if X64:
 # 6-pass bf16; RUSTPDE_MATMUL_PRECISION=high selects the 3-pass variant —
 # ~1.6x faster steps on the MXU-bound path, measured Nu drift at the 129^2
 # parity config within the f32 noise floor (see BASELINE.md).
-MATMUL_PRECISION = os.environ.get("RUSTPDE_MATMUL_PRECISION", "highest")
+MATMUL_PRECISION = env_get("RUSTPDE_MATMUL_PRECISION", "highest")
 jax.config.update("jax_default_matmul_precision", MATMUL_PRECISION)
 
 
@@ -104,7 +260,7 @@ def is_tpu_like() -> bool:
     ``RUSTPDE_FORCE_TPU_PATH=1`` forces True so CI (which runs on CPU,
     tests/conftest.py) can exercise the execution paths the real TPU uses:
     matmul transforms, dense ADI solves, fast-diagonalisation Poisson."""
-    if os.environ.get("RUSTPDE_FORCE_TPU_PATH") == "1":
+    if env_get("RUSTPDE_FORCE_TPU_PATH") == "1":
         return True
     return default_device_kind() not in ("cpu", "gpu", "cuda", "rocm")
 
@@ -114,9 +270,6 @@ def supports_complex() -> bool:
     FFT); spectral pipelines there must run real-valued matmul transforms,
     with Fourier axes in a split re/im representation."""
     return not is_tpu_like()
-
-
-from dataclasses import dataclass, field
 
 
 @dataclass

@@ -605,7 +605,6 @@ class Navier2DLnse(CampaignModelBase, Integrate):
         return float(l2_norm(u, u, v, v, t, t, beta1, beta2))
 
     def _zero_state(self) -> NavierState:
-        nav = self.navier
         return NavierState(
             temp=jnp.zeros_like(self.state.temp),
             velx=jnp.zeros_like(self.state.velx),

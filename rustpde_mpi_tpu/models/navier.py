@@ -358,9 +358,7 @@ class Navier2D(CampaignModelBase, Integrate):
         ``RUSTPDE_FORCE_FUSED_GSPMD=1`` pins the fused path — ONE shared
         helper so the two wrappers cannot drift when their manual regions
         eventually land."""
-        import os
-
-        if os.environ.get("RUSTPDE_FORCE_FUSED_GSPMD") == "1":
+        if config.env_get("RUSTPDE_FORCE_FUSED_GSPMD") == "1":
             return False
         return self._split_sep_poisoned()
 
@@ -376,13 +374,11 @@ class Navier2D(CampaignModelBase, Integrate):
           the per-stage eager fallback;
         * ``"eager"`` (``RUSTPDE_SPLIT_SEP_FALLBACK=eager``) — the old
           per-stage dispatch path, kept for triage A/Bs."""
-        import os
-
-        if os.environ.get("RUSTPDE_FORCE_FUSED_GSPMD") == "1":
+        if config.env_get("RUSTPDE_FORCE_FUSED_GSPMD") == "1":
             return "fused"
         if not self._split_sep_poisoned():
             return "fused"
-        mode = os.environ.get("RUSTPDE_SPLIT_SEP_FALLBACK", "manual")
+        mode = config.env_get("RUSTPDE_SPLIT_SEP_FALLBACK", "manual")
         if mode not in ("manual", "eager"):
             raise ValueError(
                 f"RUSTPDE_SPLIT_SEP_FALLBACK must be 'manual' or 'eager', got {mode!r}"
@@ -762,10 +758,8 @@ class Navier2D(CampaignModelBase, Integrate):
         # Gates if ever defaulted: div-norm decay, Poisson MMS, shadow,
         # FAST_SYNTH-style long-horizon stats (the r2 NaN came from a GLOBAL
         # "high"; this is the scoped form).
-        import os
-
         solve_prec = (
-            os.environ.get("RUSTPDE_SOLVE_PRECISION") or None
+            config.env_get("RUSTPDE_SOLVE_PRECISION") or None
             if not config.X64
             else None
         )

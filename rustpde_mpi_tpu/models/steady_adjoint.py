@@ -280,8 +280,6 @@ class Navier2DAdjoint(CampaignModelBase, Integrate):
                     jnp.abs(ux) * inv_dx[:, None] + jnp.abs(uy) * inv_dy[None, :]
                 )
                 ke = 0.5 * jnp.sum((ux**2 + uy**2) * w0s[:, None] * w1s[None, :])
-            uxa = sp_u.backward(velx_adj)
-            uya = sp_v.backward(vely_adj)
             ta = sp_t.backward(temp_adj)
 
             # physical gradients of the evolved + adjoint fields

@@ -24,12 +24,12 @@ Enable/disable with RUSTPDE_FOLDED (default on).
 
 from __future__ import annotations
 
-import os
 from typing import NamedTuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+from .. import config
 
 # Structure detection tolerance.  Every foldable matrix in this framework is
 # built with its symmetry *exact* (mirror-constructed transform matrices,
@@ -44,7 +44,7 @@ _MAX_BAND_OFFSETS = 8  # banded shift-apply engages up to this many diagonals
 
 
 def folding_enabled() -> bool:
-    return os.environ.get("RUSTPDE_FOLDED", "1") != "0"
+    return config.env_get("RUSTPDE_FOLDED", "1") != "0"
 
 
 # ---------------------------------------------------------------------------
@@ -672,6 +672,19 @@ class FoldedMatrix:
     @property
     def flops_factor(self) -> float:
         return self._impl.flops_factor
+
+    def set_precision(self, precision: str | None) -> bool:
+        """Override the matmul precision of the underlying apply, where the
+        impl supports one (the ``_SynthesisSep`` family declares a
+        ``precision`` hook).  Returns whether the override took — callers
+        must not assume it did: unstructured ``_Plain`` fallbacks stay at
+        session precision rather than silently carrying a dead attr.  The
+        public face of what bases.py used to do by reaching into
+        ``_impl``."""
+        if precision and hasattr(type(self._impl), "precision"):
+            self._impl.precision = precision
+            return True
+        return False
 
     def apply(self, a, axis: int):
         if self._cast is not None and a.dtype != self._cast:

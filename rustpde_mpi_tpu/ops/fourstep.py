@@ -32,11 +32,12 @@ dense transform matrices at 1e-12 (f64).
 
 from __future__ import annotations
 
-import os
 
 import numpy as np
 
 import jax.numpy as jnp
+
+from .. import config
 
 # NOTE: _MODE/_MIN are re-read from the environment on every enabled() call
 # (they are cheap lookups), so tests/scripts may toggle RUSTPDE_FOURSTEP*
@@ -44,7 +45,7 @@ import jax.numpy as jnp
 # transform path selection is construction-time, like every other operator
 # choice in the package (rebuild the Space to change it).  config.X64 is
 # process-level (jax_enable_x64 at import) and cannot toggle mid-process.
-_MODE = os.environ.get("RUSTPDE_FOURSTEP", "auto")
+_MODE = config.env_get("RUSTPDE_FOURSTEP", "auto")
 # Per-kind auto thresholds on the DFT length, measured on the v5e in f32
 # (scripts/bench_transforms.py + scripts/profile_step.py): below these the
 # folded dense GEMM wins (it is one well-shaped MXU op; the factored path's
@@ -56,9 +57,9 @@ _MODE = os.environ.get("RUSTPDE_FOURSTEP", "auto")
 # 1.13 ms vs 2.22 ms fourstep — so the DCT gate sits above every current
 # grid (re-measure before lowering).
 _MIN = {
-    "dft": int(os.environ.get("RUSTPDE_FOURSTEP_MIN", "2048")),
-    "c2c": int(os.environ.get("RUSTPDE_FOURSTEP_MIN_C2C", "1024")),
-    "dct": int(os.environ.get("RUSTPDE_FOURSTEP_MIN_DCT", "8192")),
+    "dft": int(config.env_get("RUSTPDE_FOURSTEP_MIN", "2048")),
+    "c2c": int(config.env_get("RUSTPDE_FOURSTEP_MIN_C2C", "1024")),
+    "dct": int(config.env_get("RUSTPDE_FOURSTEP_MIN_DCT", "8192")),
 }
 
 
@@ -73,19 +74,17 @@ def enabled(n: int, kind: str = "dft") -> bool:
     factored path loses at EVERY size (0.18-0.49x; the non-MXU twiddle/
     mirror/stacking passes emulate far worse than the dense GEMM's extra
     flops cost — same asymmetry as the cumsum derivative)."""
-    mode = os.environ.get("RUSTPDE_FOURSTEP", _MODE)
+    mode = config.env_get("RUSTPDE_FOURSTEP", _MODE)
     if mode == "0":
         return False
     if mode == "1":
         return viable(n, 4)
-    from .. import config
-
     if config.X64:
         return False
     env_min = {
-        "dft": os.environ.get("RUSTPDE_FOURSTEP_MIN"),
-        "c2c": os.environ.get("RUSTPDE_FOURSTEP_MIN_C2C"),
-        "dct": os.environ.get("RUSTPDE_FOURSTEP_MIN_DCT"),
+        "dft": config.env_get("RUSTPDE_FOURSTEP_MIN"),
+        "c2c": config.env_get("RUSTPDE_FOURSTEP_MIN_C2C"),
+        "dct": config.env_get("RUSTPDE_FOURSTEP_MIN_DCT"),
     }.get(kind)
     lo = int(env_min) if env_min else _MIN.get(kind, _MIN["dft"])
     return n >= lo and viable(n)
@@ -95,7 +94,7 @@ def default_factors(n: int) -> tuple[int, int]:
     """Split n = n1*n2 with n1 <= n2, n1 as close to sqrt(n) as divisibility
     allows (balanced stages minimize total GEMM flops ~ n*(n1+n2)).
     ``RUSTPDE_FOURSTEP_N1`` forces n1 for hardware tuning."""
-    forced = os.environ.get("RUSTPDE_FOURSTEP_N1")
+    forced = config.env_get("RUSTPDE_FOURSTEP_N1")
     if forced:
         n1 = int(forced)
         if n % n1 == 0:

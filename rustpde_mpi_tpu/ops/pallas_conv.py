@@ -39,7 +39,6 @@ chip A/B round.
 from __future__ import annotations
 
 import functools
-import os
 
 import jax
 import jax.numpy as jnp
@@ -55,7 +54,7 @@ def conv_kernel_choice() -> str:
     """The ``RUSTPDE_CONV_KERNEL`` knob: ``"dense"`` (default — the unfused
     per-GEMM chain) or ``"pallas"`` (this kernel).  Read at model
     compile time, like the solver-method selection."""
-    return os.environ.get("RUSTPDE_CONV_KERNEL", "dense")
+    return config.env_get("RUSTPDE_CONV_KERNEL", "dense")
 
 
 def _ceil_to(x: int, m: int) -> int:
@@ -179,13 +178,13 @@ class FusedConv:
         kx, ky = fxm.shape[0], fym.shape[0]
         self.nx, self.ny, self.mx, self.my, self.kx, self.ky = nx, ny, mx, my, kx, ky
 
-        bx = int(block_x or os.environ.get("RUSTPDE_PALLAS_CONV_BLOCK", 256))
+        bx = int(block_x or config.env_get("RUSTPDE_PALLAS_CONV_BLOCK", 256))
         bx = max(LANE, _ceil_to(bx, LANE))
         self.nxp = _ceil_to(nx, bx)
         self.bx = min(bx, self.nxp)
         self.mxp = _ceil_to(mx, LANE)
         self.myp = _ceil_to(my, LANE)
-        bj = int(block_k or os.environ.get("RUSTPDE_PALLAS_CONV_BLOCK_K", 512))
+        bj = int(block_k or config.env_get("RUSTPDE_PALLAS_CONV_BLOCK_K", 512))
         bj = max(LANE, (bj // LANE) * LANE)
         while self.myp % bj:
             bj -= LANE
@@ -335,7 +334,7 @@ def hybrid_cast():
     """The f64-hybrid cast the model convection path runs under
     ``RUSTPDE_F64_HYBRID=1`` (same convention as ``Base._sep_dev``):
     operator matrices stored f32, f64 state cast through the chain."""
-    if config.X64 and os.environ.get("RUSTPDE_F64_HYBRID") == "1":
+    if config.X64 and config.env_get("RUSTPDE_F64_HYBRID") == "1":
         return np.float32
     return None
 

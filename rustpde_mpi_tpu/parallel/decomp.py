@@ -25,7 +25,6 @@ remains the execution path for the physics.
 from __future__ import annotations
 
 import functools
-import os
 from dataclasses import dataclass
 
 import jax
@@ -34,6 +33,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 from .mesh import AXIS, PHYS, SPEC, make_mesh  # noqa: F401  (re-exported)
+from ..config import env_get
 
 try:  # jax>=0.4.35
     from jax import shard_map
@@ -195,13 +195,13 @@ def transpose_method() -> str:
     """The RUSTPDE_TRANSPOSE knob (default ``alltoall``) — selection stays
     measurement-driven like solver.default_method; ``bench.py pallasconv``
     records the A/B when a chip is attached."""
-    return os.environ.get("RUSTPDE_TRANSPOSE", "alltoall")
+    return env_get("RUSTPDE_TRANSPOSE", "alltoall")
 
 
 def _pallas_ring_available() -> bool:
     return (
         jax.devices()[0].platform in ("tpu", "axon")
-        and os.environ.get("RUSTPDE_RING_IMPL", "pallas") != "ppermute"
+        and env_get("RUSTPDE_RING_IMPL", "pallas") != "ppermute"
     )
 
 

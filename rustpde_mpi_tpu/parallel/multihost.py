@@ -34,6 +34,8 @@ import jax
 import numpy as np
 
 from .mesh import make_mesh
+from . import sanitizer as _sanitizer
+from ..config import env_get
 
 
 def _cluster_env_configured() -> bool:
@@ -152,7 +154,7 @@ def host_local_array(arr: jax.Array, spec: tuple | None = None) -> np.ndarray:
     the spectral x-pencil layout every model state uses) — a same-device
     resharding, metadata-only when the layouts already agree."""
     if jax.process_count() == 1:
-        return np.asarray(arr)
+        return np.asarray(arr)  # lint-ok: RPD005 single-process: every shard is addressable by definition
     from jax.experimental import multihost_utils
 
     from .mesh import SPEC, make_mesh
@@ -175,11 +177,14 @@ def allgather_host(value) -> np.ndarray:
     commit uses this to exchange per-shard digests/byte counts so root can
     write the manifest without re-reading any shard file.  Single-host:
     the value with a length-1 leading axis."""
+    _sanitizer.record("allgather", payload=value)
     if jax.process_count() == 1:
-        return np.asarray(value)[None]
+        return np.asarray(value)[None]  # lint-ok: RPD005 allgather payloads are small host values by contract
     from jax.experimental import multihost_utils
 
-    return np.asarray(multihost_utils.process_allgather(np.asarray(value)))
+    out = np.asarray(multihost_utils.process_allgather(np.asarray(value)))  # lint-ok: RPD005 allgather payloads are small host values by contract
+    _sanitizer.maybe_verify()
+    return out
 
 
 def broadcast(value, is_source: bool | None = None):
@@ -194,21 +199,29 @@ def broadcast(value, is_source: bool | None = None):
     broadcasts per boundary, most of them outside any dispatch watchdog, so
     the structured-exit contract (journaled error stop, requests recovered
     on restart) needs the timeout here too."""
+    if _sanitizer.skip_broadcast_injected():
+        # armed desync injection (tests): this host skips the collective
+        # entirely — no record, no broadcast — the PR-10 bug shape
+        return np.asarray(value)  # lint-ok: RPD005 broadcast payloads are small host values by contract
+    _sanitizer.record("broadcast", payload=value)
     if jax.process_count() == 1:
-        return np.asarray(value)
+        return np.asarray(value)  # lint-ok: RPD005 broadcast payloads are small host values by contract
     from jax.experimental import multihost_utils
 
     def run():
         return multihost_utils.broadcast_one_to_all(
-            np.asarray(value), is_source=is_source
+            np.asarray(value), is_source=is_source  # lint-ok: RPD005 broadcast payloads are small host values by contract
         )
 
-    timeout = float(os.environ.get("RUSTPDE_SYNC_TIMEOUT_S", "0") or 0.0)
+    timeout = float(env_get("RUSTPDE_SYNC_TIMEOUT_S", "0") or 0.0)
     if timeout <= 0:
-        return run()
-    from ..utils.resilience import call_with_watchdog
+        out = run()
+    else:
+        from ..utils.resilience import call_with_watchdog
 
-    return call_with_watchdog(run, timeout, label="broadcast")
+        out = call_with_watchdog(run, timeout, label="broadcast")
+    _sanitizer.maybe_verify()
+    return out
 
 
 def broadcast_obj(obj=None):
@@ -235,7 +248,7 @@ def broadcast_obj(obj=None):
         buf[:] = np.frombuffer(payload, dtype=np.uint8)
     # the collective may widen the dtype (psum upcast): cast back before
     # reinterpreting the element values as utf-8 bytes
-    data = np.asarray(broadcast(buf)).astype(np.uint8)
+    data = np.asarray(broadcast(buf)).astype(np.uint8)  # lint-ok: RPD005 broadcast returns a host numpy value
     return json.loads(data.tobytes().decode("utf-8"))
 
 
@@ -248,6 +261,7 @@ def root_decides(local: bool) -> bool:
     deliberately IGNORED.  Single-host (or uninitialized runtime): the
     local flag.  One copy of the primitive — the resilient runner and the
     serve scheduler both ride it, so the handshake cannot drift."""
+    _sanitizer.record("root_decides")
     try:
         if jax.process_count() == 1:
             return bool(local)
@@ -274,18 +288,20 @@ def sync_hosts(tag: str = "barrier") -> None:
     dumped to stderr together with the barrier tag, and a structured
     :class:`~rustpde_mpi_tpu.utils.resilience.DispatchHang` is raised so the
     scheduler sees a crash it can restart instead of a wedged job."""
+    _sanitizer.record("sync", tag=tag)
     if jax.process_count() == 1:
         return
     from jax.experimental import multihost_utils
 
-    timeout = float(os.environ.get("RUSTPDE_SYNC_TIMEOUT_S", "0") or 0.0)
+    timeout = float(env_get("RUSTPDE_SYNC_TIMEOUT_S", "0") or 0.0)
     if timeout <= 0:
         multihost_utils.sync_global_devices(tag)
-        return
-    from ..utils.resilience import call_with_watchdog
+    else:
+        from ..utils.resilience import call_with_watchdog
 
-    call_with_watchdog(
-        lambda: multihost_utils.sync_global_devices(tag),
-        timeout,
-        label=f"sync_hosts({tag!r})",
-    )
+        call_with_watchdog(
+            lambda: multihost_utils.sync_global_devices(tag),
+            timeout,
+            label=f"sync_hosts({tag!r})",
+        )
+    _sanitizer.maybe_verify()

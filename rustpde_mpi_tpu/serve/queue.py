@@ -35,27 +35,15 @@ import threading
 import time
 
 from .request import AdmissionError, RequestError, SimRequest
+from ..utils.fsutil import fsync_dir
 
 _STATES = ("queued", "running", "done", "failed")
 
 
-def _fsync_dir(path: str) -> None:
-    """fsync a DIRECTORY: ``os.replace``/``os.remove`` mutate the directory
-    entry, and that mutation is only durable across power loss once the
-    directory inode itself is synced — the file's own fsync covers the
-    bytes, not the name.  Without this, the request-never-lost guarantee
-    rests on the filesystem journaling renames by luck.  Best-effort on
-    filesystems that reject directory fsync (some network mounts)."""
-    try:
-        fd = os.open(path, os.O_RDONLY)
-    except OSError:
-        return
-    try:
-        os.fsync(fd)
-    except OSError:
-        pass
-    finally:
-        os.close(fd)
+# one shared durability primitive (utils/fsutil): os.replace alone leaves
+# the new dirent in page cache — the request-never-lost guarantee would
+# rest on the filesystem journaling renames by luck
+_fsync_dir = fsync_dir
 
 
 def _atomic_write(path: str, text: str) -> None:

@@ -90,7 +90,7 @@ import time
 
 import numpy as np
 
-from ..config import IOConfig, ServeConfig
+from ..config import IOConfig, ServeConfig, env_get
 from ..models.ensemble import NavierEnsemble
 from ..telemetry import metrics as _tm
 from ..telemetry import tracing as _tr
@@ -172,7 +172,7 @@ class SimServer:
         self.journal_path = os.path.join(self.cfg.run_dir, "journal.jsonl")
         self._journal_writer = JournalWriter(self.journal_path)
         self._fault = FaultPlan.from_spec(
-            fault if fault is not None else os.environ.get("RUSTPDE_FAULT")
+            fault if fault is not None else env_get("RUSTPDE_FAULT")
         )
         self._drain = False
         self._runner: ResilientRunner | None = None
@@ -966,7 +966,7 @@ class SimServer:
 
         def plan_fill():
             plan = {"assign": [], "quantum": False, "claims": self._campaign_claims}
-            if self._drain:
+            if self._drain:  # lint-ok: RPD001 root-only plan closure; the returned plan is broadcast_obj'd before any host acts
                 # drain check lives INSIDE the root plan: a host-local
                 # early-return here would skip the broadcast on the host
                 # the signal landed on while its peers enter it — one

@@ -22,6 +22,7 @@ import os
 import time as _time
 
 from . import metrics as _metrics
+from .. import config as _config
 
 #: Content-Type of the Prometheus text exposition format
 PROMETHEUS_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
@@ -95,7 +96,7 @@ class MetricsDumper:
         registry=None,
     ):
         if every_s is None:
-            env = os.environ.get("RUSTPDE_METRICS_DUMP_S", "")
+            env = _config.env_get("RUSTPDE_METRICS_DUMP_S", "")
             every_s = float(env) if env else 60.0
         self.path = path
         self.every_s = float(every_s)

@@ -37,11 +37,11 @@ journals it — see README "Telemetry").
 from __future__ import annotations
 
 import math
-import os
 import threading
 import time as _time
+from .. import config as _config
 
-_ENABLED = os.environ.get("RUSTPDE_TELEMETRY", "1") != "0"
+_ENABLED = _config.env_get("RUSTPDE_TELEMETRY", "1") != "0"
 
 
 def enabled() -> bool:

@@ -30,12 +30,13 @@ import os
 import threading
 import time as _time
 from collections import deque
+from .. import config as _config
 
 # RUSTPDE_TELEMETRY=0 is the master kill switch; RUSTPDE_TRACE=0 turns off
 # just the tracing half (metrics keep recording)
 _ENABLED = (
-    os.environ.get("RUSTPDE_TRACE", "1") != "0"
-    and os.environ.get("RUSTPDE_TELEMETRY", "1") != "0"
+    _config.env_get("RUSTPDE_TRACE", "1") != "0"
+    and _config.env_get("RUSTPDE_TELEMETRY", "1") != "0"
 )
 
 
@@ -59,7 +60,7 @@ class FlightRecorder:
 
     def __init__(self, capacity: int | None = None):
         if capacity is None:
-            capacity = int(os.environ.get("RUSTPDE_TRACE_EVENTS", "4096") or 4096)
+            capacity = int(_config.env_get("RUSTPDE_TRACE_EVENTS", "4096") or 4096)
         self.capacity = max(16, int(capacity))
         self._events: deque = deque(maxlen=self.capacity)
         self._lock = threading.Lock()

@@ -78,6 +78,9 @@ from contextlib import ExitStack, contextmanager
 import numpy as np
 
 from ..bases import BaseKind, Space2
+
+from ..config import env_get
+from .fsutil import fsync_dir
 from ..field import grid_deltas
 
 _VARS = (("ux", "velx"), ("uy", "vely"), ("temp", "temp"), ("pres", "pres"))
@@ -328,11 +331,10 @@ def _atomic_h5_write(
         finally:
             os.close(fd)
         os.replace(tmp, filename)
-        dfd = os.open(dirname, os.O_RDONLY)
-        try:
-            os.fsync(dfd)
-        finally:
-            os.close(dfd)
+        # strict: a failed dirsync must fail the write (the two-phase
+        # commit would otherwise report a checkpoint committed whose
+        # dirent can roll back across power loss)
+        fsync_dir(dirname, strict=True)
     finally:
         if os.path.exists(tmp):
             try:
@@ -882,7 +884,7 @@ def _shard_crash_hook(point: str, step) -> None:
     so the raise normally lands before any stepping."""
     from .faults import parse_shard_crash_spec
 
-    plan = parse_shard_crash_spec(os.environ.get("RUSTPDE_SHARD_CRASH"))
+    plan = parse_shard_crash_spec(env_get("RUSTPDE_SHARD_CRASH"))
     if plan is None or step is None:
         return
     want, at, host = plan
@@ -1100,14 +1102,18 @@ def commit_sharded_snapshot(
     oks = [bool(row[40]) for row in reports]
     digests = [bytes(row[:32]).hex() for row in reports]
     nbytes = [int(np.frombuffer(bytes(row[32:40]), np.int64)[0]) for row in reports]
+    # the abort decision derives ONLY from the allgathered ok flags —
+    # fleet-agreed data, so every host takes the same branch into the
+    # abort barrier (lint RPD001 checks exactly this property)
+    ok_all = all(oks)
     stats = {
-        "ok": all(oks),
+        "ok": ok_all,
         "shards": int(snap.shard_count),
         "bytes_host": int(snap.nbytes),
         "bytes_total": int(sum(nbytes)),
         "barrier_s": round(barrier_s, 3),
     }
-    if not stats["ok"]:
+    if not ok_all:
         multihost.sync_hosts("rustpde-ckpt-abort")
         return stats
     if _process_index() == 0:

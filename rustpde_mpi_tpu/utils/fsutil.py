@@ -1,0 +1,40 @@
+"""Durability primitives shared by every module with an on-disk guarantee.
+
+``os.replace``/``os.remove`` mutate the parent DIRECTORY: until the
+directory inode itself is fsynced, the new dirent lives only in page
+cache and a power loss can roll the rename back even though the file's
+own bytes were fsynced.  One shared :func:`fsync_dir` (extracted from
+serve/queue.py's ``_fsync_dir``) keeps the pattern in one place — lint
+rule RPD004 requires every ``os.replace``/``os.rename`` in a
+durability-critical module to be paired with it.
+
+Import-light on purpose (os only): utils/checkpoint.py calls it from
+inside the two-phase commit window and background writer threads.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def fsync_dir(path: str, strict: bool = False) -> None:
+    """fsync a DIRECTORY so a just-renamed/removed dirent survives power
+    loss.  Default is best-effort (filesystems that reject directory fsync
+    — some network mounts — degrade quietly, the queue's historical
+    behavior); ``strict=True`` propagates the OSError instead, for writers
+    whose COMMIT semantics ride on the dirent being durable (the
+    checkpoint two-phase protocol must report such a write failed, not
+    committed)."""
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        if strict:
+            raise
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        if strict:
+            raise
+    finally:
+        os.close(fd)

@@ -62,6 +62,7 @@ import time as _time
 from collections import deque
 
 from ..telemetry import metrics as _tm
+from ..config import env_get
 
 
 class AsyncWriteError(RuntimeError):
@@ -422,7 +423,7 @@ class IOPipeline:
         if timeout_s is None:
             import os
 
-            env = os.environ.get("RUSTPDE_IO_TIMEOUT_S")
+            env = env_get("RUSTPDE_IO_TIMEOUT_S")
             timeout_s = float(env) if env else None
         self.writer = AsyncCheckpointWriter(depth=queue_depth, timeout_s=timeout_s)
         self.diag_lag = max(0, int(diag_lag))

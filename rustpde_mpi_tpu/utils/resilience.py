@@ -78,6 +78,9 @@ from . import checkpoint
 from .faults import FaultPlan, FaultSpecError, validate_fault_env  # noqa: F401
 from .governor import StabilityGovernor
 from .integrate import integrate
+
+from ..config import env_get
+from ..parallel import sanitizer as _sanitizer
 from .io_pipeline import IOPipeline
 from .journal import JournalWriter, read_journal
 
@@ -309,7 +312,7 @@ class ResilientRunner:
         self.respawn_amp = float(respawn_amp)
         self.respawn_seed = respawn_seed
         if dispatch_timeout_s is None:
-            env = os.environ.get("RUSTPDE_DISPATCH_TIMEOUT_S", "")
+            env = env_get("RUSTPDE_DISPATCH_TIMEOUT_S", "")
             dispatch_timeout_s = float(env) if env else None
         self.dispatch_timeout_s = dispatch_timeout_s
         # STRICT env validation at construction (utils/faults): a malformed
@@ -318,10 +321,10 @@ class ResilientRunner:
         # while testing nothing
         validate_fault_env()
         self.fault = FaultPlan.from_spec(
-            fault if fault is not None else os.environ.get("RUSTPDE_FAULT")
+            fault if fault is not None else env_get("RUSTPDE_FAULT")
         )
         if spike_factor is None:
-            env = os.environ.get("RUSTPDE_SPIKE_FACTOR", "")
+            env = env_get("RUSTPDE_SPIKE_FACTOR", "")
             spike_factor = float(env) if env else 50.0
         self.spike_factor = float(spike_factor)
         self.resume = bool(resume)
@@ -1281,6 +1284,9 @@ class ResilientRunner:
                 os.path.join(self.run_dir, "metrics.jsonl")
             )
             self._exit_disarm = _tr.arm_exit_dump(self.run_dir, lambda: self.step)
+        # a collective-desync trip mid-session should drop its flight
+        # record next to the journal, like every other incident dump
+        _sanitizer.set_run_dir(self.run_dir)
         try:
             if self.resume if resume is None else resume:
                 self.resumed = self._maybe_resume()
@@ -1487,6 +1493,21 @@ class ResilientRunner:
         if self._async_ckpt or self._overlap:
             self._io = IOPipeline(queue_depth=io.queue_depth, diag_lag=io.diag_lag)
             self.pde.io_pipeline = self._io
+
+    @property
+    def last_checkpoint(self) -> str | None:
+        """Path of the newest verified/committed checkpoint (None before the
+        first write).  Public embedding surface — workload drivers report it
+        instead of reaching into runner internals."""
+        return self._last_ckpt_path
+
+    def drain_io(self) -> None:
+        """Settle the IO pipeline: flush lagged diagnostics, commit any
+        pending sharded write, wait for background writers and surface the
+        first write failure.  Public embedding surface (workload drivers
+        settle before sweeping spent checkpoints); :meth:`run` calls it at
+        every normal completion."""
+        self._drain_io()
 
     def _drain_io(self) -> None:
         """Flush lagged diagnostics + wait for background writes, surfacing

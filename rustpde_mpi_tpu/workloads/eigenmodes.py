@@ -186,7 +186,7 @@ def eigenmode_sweep(
                 # checkpoints were kill-insurance, now spent — sweep them
                 # so a rerun measures fresh instead of resuming complete
                 # (with zero samples, hence NaN rates)
-                runner._drain_io()
+                runner.drain_io()
                 for path in checkpoint.checkpoint_files(runner.run_dir):
                     checkpoint.remove_checkpoint(path)
         sigma = (

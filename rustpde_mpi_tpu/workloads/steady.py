@@ -159,5 +159,5 @@ def steady_state_find(
         "iterations": int(runner.step),
         "preempted": preempted,
         "resumed": bool(runner.resumed),
-        "checkpoint": runner._last_ckpt_path,
+        "checkpoint": runner.last_checkpoint,
     }

@@ -1,0 +1,96 @@
+"""Project lint CLI: the repo's own AST rules + the curated generic layer.
+
+Usage:
+    python scripts/lint.py                    # whole repo, exit 1 on NEW findings
+    python scripts/lint.py rustpde_mpi_tpu    # subtree only
+    python scripts/lint.py --json             # machine-readable payload
+    python scripts/lint.py --update-baseline  # grandfather current findings
+                                              # (then EDIT the reasons)
+    python scripts/lint.py --show-baselined   # list grandfathered findings
+
+Exit codes: 0 clean (baselined findings allowed), 1 new findings, 2 stale
+baseline entries (the flagged code changed or was fixed — prune the entry).
+Rule inventory and the historical bug each rule encodes: README "Static
+analysis & sanitizer".
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+from tools.lint import core  # noqa: E402
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("paths", nargs="*", help="repo-relative files/dirs (default: full scope)")
+    ap.add_argument("--json", action="store_true", help="JSON payload to stdout")
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="write current NEW findings into the baseline (edit reasons after)")
+    ap.add_argument("--show-baselined", action="store_true")
+    ap.add_argument("--baseline", default=core.DEFAULT_BASELINE)
+    args = ap.parse_args()
+
+    result = core.run_lint(root=_REPO, paths=args.paths or None,
+                           baseline_path=args.baseline)
+
+    if args.update_baseline:
+        entries = core.load_baseline(args.baseline)
+        for f in result.new:
+            entries.append(
+                {
+                    "rule": f.rule,
+                    "path": f.path,
+                    "context": f.context,
+                    "snippet": f.snippet,
+                    "reason": "TODO: write why this finding is acceptable",
+                }
+            )
+        core.save_baseline(entries, args.baseline)
+        print(f"baselined {len(result.new)} findings into {args.baseline} "
+              "— now edit the reasons")
+        return 0
+
+    if args.json:
+        print(json.dumps(
+            {
+                "engine": result.engine,
+                "files": result.files,
+                "new": [f.to_dict() for f in result.new],
+                "counts": result.counts,
+                "baselined_counts": result.baselined_counts,
+                "suppressed": result.suppressed,
+                "stale_baseline": result.stale_baseline,
+            },
+            indent=1,
+        ))
+    else:
+        for f in result.new:
+            print(f)
+        if args.show_baselined:
+            for f in result.baselined:
+                print(f"[baselined] {f}")
+        print(
+            f"lint: {result.files} files, engine={result.engine}, "
+            f"{len(result.new)} new, {len(result.baselined)} baselined, "
+            f"{result.suppressed} suppressed, {len(result.stale_baseline)} stale"
+        )
+    # full-scope runs enforce baseline hygiene; partial runs can't tell
+    # whether an entry is stale (its file may simply be out of scope)
+    if result.new:
+        return 1
+    if result.stale_baseline and not args.paths:
+        for e in result.stale_baseline:
+            print(f"stale baseline entry: {e['rule']} {e['path']} — {e.get('snippet','')!r}")
+        return 2
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

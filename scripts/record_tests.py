@@ -120,6 +120,10 @@ def main() -> int:
         # names an instrumented run registers — a per-PR record of the
         # observable vocabulary, like the journal schema rows
         "metrics": _metrics_snapshot(),
+        # static-analysis payload (LINT.json written alongside): rule ->
+        # count for both lint layers + baseline size, with a delta gate —
+        # NEW findings (or stale baseline entries) fail the record run
+        "lint": _lint_payload(),
         "date": _utc_now(),
     }
     _persist(record, tier_key)
@@ -127,6 +131,14 @@ def main() -> int:
     if proc.returncode != 0:
         sys.stderr.write(proc.stdout[-4000:])
         return proc.returncode
+    lint = record["lint"] or {}
+    if lint.get("clean") is False:
+        sys.stderr.write(
+            "lint baseline-delta gate: new findings "
+            f"{lint.get('counts')} (stale baseline: {lint.get('stale')}) — "
+            "run scripts/lint.py\n"
+        )
+        return 4
     # the budget gate applies to the FAST (= tier-1) selection only: slow-
     # tier tests (multiprocess spawns, soaks) legitimately run for minutes
     if args.fast and record["over_budget"]:
@@ -403,6 +415,43 @@ def _metrics_snapshot() -> dict | None:
     os.replace(tmp, path)
     # the TESTS.json row carries the compact inventory, not the full dump
     return {"names": payload["names"], "date": payload["date"]}
+
+
+def _lint_payload() -> dict | None:
+    """Run ``scripts/lint.py --json`` and persist the rule->count payload
+    of both lint layers as LINT.json (project RPD rules + the curated
+    GEN ruff-subset, engine recorded), with the baseline counts alongside
+    so the delta is visible per-PR.  ``clean`` False (new findings or a
+    stale baseline entry) fails the record run via rc=4.  Best-effort on
+    infrastructure errors: the error string is recorded instead."""
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.join(_REPO, "scripts", "lint.py"), "--json"],
+            capture_output=True,
+            text=True,
+            timeout=600,
+            cwd=_REPO,
+        )
+        data = json.loads(proc.stdout)
+    except Exception as exc:  # noqa: BLE001 — recording must not fail the run
+        return {"error": f"{type(exc).__name__}: {exc}"}
+    payload = {
+        "engine": data.get("engine"),
+        "files": data.get("files"),
+        "counts": data.get("counts", {}),
+        "baselined_counts": data.get("baselined_counts", {}),
+        "suppressed": data.get("suppressed", 0),
+        "stale": len(data.get("stale_baseline", [])),
+        "new": len(data.get("new", [])),
+        "clean": proc.returncode == 0,
+        "date": _utc_now(),
+    }
+    path = os.path.join(_REPO, "LINT.json")
+    tmp = f"{path}.{os.getpid()}.tmp"
+    with open(tmp, "w") as f:
+        json.dump(payload, f, indent=1)
+    os.replace(tmp, path)
+    return payload
 
 
 def _utc_now() -> str:

@@ -276,6 +276,8 @@ def mode_serve_campaign(out_dir):
                 except AdmissionError:
                     pass
     summary = srv.serve()
+    from rustpde_mpi_tpu.parallel import sanitizer
+
     if multihost.is_root():
         events = [
             e.get("event")
@@ -291,6 +293,9 @@ def mode_serve_campaign(out_dir):
                     "failed": summary["failed"],
                     "retried": summary["retried"],
                     "replans": summary["replans"],
+                    # collective-sequence sanitizer counters (armed via
+                    # RUSTPDE_SANITIZE in the chaos soak / bench mp leg)
+                    "sanitizer": sanitizer.stats(),
                     "queue": srv.queue.counts(),
                     "slots": slots,
                     "nproc": jax.process_count(),
@@ -312,6 +317,38 @@ def mode_serve_campaign(out_dir):
                 },
                 f,
             )
+
+
+def mode_sanitize_desync(out_dir):
+    """Collective-sequence sanitizer exercise (tests/test_sanitizer.py).
+
+    Drives a pure root_decides loop (one fixed-shape scalar broadcast per
+    call, so a skipped call leaves the transport pairable) with the
+    sanitizer armed from the environment.  With
+    ``RUSTPDE_SANITIZE_INJECT=skip_broadcast@<n>:host1`` armed, host 1
+    silently skips its <n>-th broadcast — the PR-10 drain-check bug shape —
+    and BOTH ranks must raise a typed CollectiveDesyncError naming the
+    divergent call site within one verification cadence, instead of
+    wedging silently.  Each rank writes its own result file."""
+    from rustpde_mpi_tpu.parallel import multihost, sanitizer
+    from rustpde_mpi_tpu.parallel.sanitizer import CollectiveDesyncError
+
+    sanitizer.reset()  # pick up the spawn env on a clean ring
+    result = {"raised": None, "site": None, "seq": None, "message": None}
+    try:
+        for i in range(40):
+            multihost.root_decides(i % 3 == 0)
+        multihost.sync_hosts("sanitize-clean-done")
+    except CollectiveDesyncError as exc:
+        result["raised"] = "CollectiveDesyncError"
+        result["site"] = exc.site
+        result["seq"] = exc.seq
+        result["message"] = str(exc)
+    result["stats"] = sanitizer.stats()
+    with open(
+        os.path.join(out_dir, f"sanitize_rank{jax.process_index()}.json"), "w"
+    ) as f:
+        json.dump(result, f)
 
 
 def main():
@@ -340,6 +377,8 @@ def main():
         mode_bench_sharded(out_dir)
     elif mode == "serve_campaign":
         mode_serve_campaign(out_dir)
+    elif mode == "sanitize_desync":
+        mode_sanitize_desync(out_dir)
     else:
         raise SystemExit(f"unknown mode {mode!r}")
     print(f"RANK{pid} OK", flush=True)

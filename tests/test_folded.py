@@ -82,7 +82,6 @@ def test_unstructured_matrix_is_plain():
 
 
 def test_folded_accepts_complex_input():
-    base = chebyshev(16)
     fm = FoldedMatrix(chb.synthesis_matrix(16), _dev)
     rng = np.random.default_rng(2)
     x = jnp.asarray(rng.standard_normal((16, 3)) + 1j * rng.standard_normal((16, 3)))

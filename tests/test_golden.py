@@ -132,7 +132,6 @@ def test_fastdiag_equals_tensorsolver(bx, by, c, alpha, cls):
     nx, ny = 16, 11
     space = Space2(mk[bx](nx), mk[by](ny))
     rng = np.random.default_rng(42)
-    shape = (space.base_x.m if bx != "fourier" else nx, ny)
     b = rng.standard_normal((nx if bx != "fourier" else nx, ny))
     bhat = np.asarray(space.forward(b))
     rhs = np.asarray(space.to_ortho(bhat))

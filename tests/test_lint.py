@@ -1,0 +1,445 @@
+"""Static-analysis layer (tools/lint) + the env-knob registry contract.
+
+The fixture snippets reproduce the repo's own FIXED bugs — the PR-10
+drain-check-outside-the-root-plan desync and the PR-5
+np.asarray-on-a-sharded-array fetch — and assert each rule flags the buggy
+shape while the shipped fix passes clean.  A repo-wide test keeps HEAD
+lint-clean (zero unsuppressed findings, zero stale baseline entries), and
+the knob test diffs ``config.env_knobs()`` against a grep of the source
+tree AND the README knob table, so a new ``RUSTPDE_*`` knob cannot ship
+unregistered or undocumented.
+"""
+
+import os
+import re
+
+from tools.lint import core, lint_source, run_lint
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def rules_of(findings):
+    return sorted(f.rule for f in findings)
+
+
+# -- RPD001: collective under a host-local condition (the PR-10 bug) ----------
+
+PR10_DRAIN_BUG = '''
+def _fill_slots(self, slots, key):
+    if self._drain:
+        return
+    plan = broadcast_obj(self._plan())
+    self._apply(plan)
+'''
+
+PR10_DRAIN_FIXED = '''
+def _fill_slots(self, slots, key):
+    drain = root_decides(self._drain)
+    if drain:
+        return
+    plan = broadcast_obj(self._plan())
+    self._apply(plan)
+'''
+
+
+def test_rpd001_flags_drain_check_outside_root_plan():
+    found = lint_source(PR10_DRAIN_BUG, "rustpde_mpi_tpu/serve/scheduler.py")
+    assert "RPD001" in rules_of(found)
+    (f,) = [f for f in found if f.rule == "RPD001"]
+    assert "early-exit" in f.message
+
+
+def test_rpd001_fixed_form_passes():
+    found = lint_source(PR10_DRAIN_FIXED, "rustpde_mpi_tpu/serve/scheduler.py")
+    assert "RPD001" not in rules_of(found)
+
+
+def test_rpd001_collective_inside_host_local_branch():
+    src = '''
+def go(self):
+    if is_root():
+        sync_hosts("inside")
+'''
+    found = lint_source(src, "rustpde_mpi_tpu/serve/scheduler.py")
+    assert "RPD001" in rules_of(found)
+
+
+def test_rpd001_out_of_scope_module_not_flagged():
+    found = lint_source(PR10_DRAIN_BUG, "rustpde_mpi_tpu/models/navier.py")
+    assert "RPD001" not in rules_of(found)
+
+
+# -- RPD002: collective on an exception path ----------------------------------
+
+
+def test_rpd002_sync_in_except_and_finally():
+    src = '''
+def teardown(self):
+    try:
+        self.close()
+    except Exception:
+        sync_hosts("bye")
+    finally:
+        broadcast(1)
+'''
+    found = lint_source(src, "rustpde_mpi_tpu/serve/scheduler.py")
+    assert rules_of([f for f in found if f.rule == "RPD002"]) == ["RPD002", "RPD002"]
+
+
+# -- RPD003: use after donate -------------------------------------------------
+
+DONATE_BUG = '''
+import jax
+
+step = jax.jit(_step, donate_argnums=(0,))
+
+def advance(state):
+    new = step(state)
+    return state
+'''
+
+DONATE_FIXED = '''
+import jax
+
+step = jax.jit(_step, donate_argnums=(0,))
+
+def advance(state):
+    state = step(state)
+    return state
+'''
+
+
+def test_rpd003_use_after_donate():
+    found = lint_source(DONATE_BUG, "rustpde_mpi_tpu/models/fixture.py")
+    assert "RPD003" in rules_of(found)
+    assert "RPD003" not in rules_of(
+        lint_source(DONATE_FIXED, "rustpde_mpi_tpu/models/fixture.py")
+    )
+
+
+# -- RPD004: os.replace without a parent-dir fsync ----------------------------
+
+
+def test_rpd004_replace_without_dirsync():
+    bug = '''
+import os
+
+def commit(tmp, dst):
+    os.replace(tmp, dst)
+'''
+    fixed = '''
+import os
+
+def commit(tmp, dst):
+    os.replace(tmp, dst)
+    fsync_dir(os.path.dirname(dst))
+'''
+    assert "RPD004" in rules_of(lint_source(bug, "rustpde_mpi_tpu/serve/queue.py"))
+    assert "RPD004" not in rules_of(lint_source(fixed, "rustpde_mpi_tpu/serve/queue.py"))
+    # non-durability modules are out of scope (best-effort caches etc.)
+    assert "RPD004" not in rules_of(lint_source(bug, "rustpde_mpi_tpu/tools/xdmf.py"))
+
+
+# -- RPD005: asarray on a possibly-sharded array (the PR-5 bug) ---------------
+
+PR5_ASARRAY_BUG = '''
+import numpy as np
+
+def poison_mask(model):
+    leaf = model.state.temp
+    return np.asarray(leaf)
+'''
+
+PR5_ASARRAY_FIXED = '''
+import numpy as np
+
+def poison_mask(model):
+    leaf = model.state.temp
+    return np.asarray(leaf.addressable_data(0))
+'''
+
+
+def test_rpd005_flags_asarray_on_sharded_leaf():
+    found = lint_source(PR5_ASARRAY_BUG, "rustpde_mpi_tpu/utils/checkpoint.py")
+    assert "RPD005" in rules_of(found)
+
+
+def test_rpd005_addressable_fetch_passes():
+    found = lint_source(PR5_ASARRAY_FIXED, "rustpde_mpi_tpu/utils/checkpoint.py")
+    assert "RPD005" not in rules_of(found)
+
+
+def test_rpd005_host_scalars_pass():
+    src = '''
+import numpy as np
+
+def pack(h5, t):
+    a = np.asarray(float(t))
+    b = np.asarray(h5["time"])
+    return a, b
+'''
+    assert "RPD005" not in rules_of(
+        lint_source(src, "rustpde_mpi_tpu/utils/checkpoint.py")
+    )
+
+
+# -- RPD006: raw RUSTPDE_* env reads ------------------------------------------
+
+
+def test_rpd006_raw_env_read_flagged_outside_config():
+    src = '''
+import os
+
+def fault():
+    return os.environ.get("RUSTPDE_FAULT")
+'''
+    assert "RPD006" in rules_of(
+        lint_source(src, "rustpde_mpi_tpu/utils/resilience.py")
+    )
+    # the two allowed modules stay raw by design
+    assert "RPD006" not in rules_of(
+        lint_source(src, "rustpde_mpi_tpu/utils/faults.py")
+    )
+    assert "RPD006" not in rules_of(lint_source(src, "rustpde_mpi_tpu/config.py"))
+
+
+def test_rpd006_module_level_subscript_read_flagged():
+    src = 'import os\n_FLAG = os.environ["RUSTPDE_FAULT"]\n'
+    assert "RPD006" in rules_of(
+        lint_source(src, "rustpde_mpi_tpu/utils/resilience.py")
+    )
+
+
+def test_rpd006_env_get_passes():
+    src = '''
+from ..config import env_get
+
+def fault():
+    return env_get("RUSTPDE_FAULT")
+'''
+    assert "RPD006" not in rules_of(
+        lint_source(src, "rustpde_mpi_tpu/utils/resilience.py")
+    )
+
+
+# -- RPD007: cross-module private reach ---------------------------------------
+
+
+def test_rpd007_private_reach_on_constructed_import():
+    src = '''
+from ..utils.resilience import ResilientRunner
+
+def drive(model):
+    runner = ResilientRunner(model)
+    runner._drain_io()
+'''
+    assert "RPD007" in rules_of(
+        lint_source(src, "rustpde_mpi_tpu/workloads/fixture.py")
+    )
+    fixed = src.replace("runner._drain_io()", "runner.drain_io()")
+    assert "RPD007" not in rules_of(
+        lint_source(fixed, "rustpde_mpi_tpu/workloads/fixture.py")
+    )
+
+
+def test_rpd007_stdlib_and_namedtuple_idioms_pass():
+    src = '''
+import sys
+import os
+
+def f(state):
+    frame = sys._getframe(1)
+    os._exit(9)
+    return state._fields
+'''
+    assert "RPD007" not in rules_of(
+        lint_source(src, "rustpde_mpi_tpu/utils/fixture.py")
+    )
+
+
+# -- generic layer ------------------------------------------------------------
+
+
+def test_gen_unused_import_and_noqa():
+    src = "import json\nimport os  # noqa: F401\nprint(1)\n"
+    found = lint_source(src, "rustpde_mpi_tpu/serve/fixture.py")
+    assert [f.rule for f in found] == ["GEN-F401"]
+    assert "json" in found[0].message
+
+
+def test_gen_unused_local():
+    src = '''
+def f():
+    x = compute()
+    _scratch = compute()
+    return 1
+'''
+    found = [f for f in lint_source(src, "rustpde_mpi_tpu/serve/fixture.py")
+             if f.rule == "GEN-F841"]
+    assert len(found) == 1 and "'x'" in found[0].message
+
+
+def test_gen_class_attribute_is_not_a_local():
+    src = '''
+def make():
+    class Handler:
+        timeout = 30.0
+    return Handler
+'''
+    assert "GEN-F841" not in rules_of(
+        lint_source(src, "rustpde_mpi_tpu/serve/fixture.py")
+    )
+
+
+def test_gen_mutable_default():
+    src = "def f(a, b=[]):\n    return a\n"
+    assert "GEN-B006" in rules_of(lint_source(src, "rustpde_mpi_tpu/fixture.py"))
+
+
+def test_gen_fstring_without_placeholder_and_format_spec_regression():
+    src = 'x = f"plain"\ny = f"{x:.3e} ok"\n'
+    found = [f for f in lint_source(src, "rustpde_mpi_tpu/fixture.py")
+             if f.rule == "GEN-F541"]
+    # exactly ONE: the format-spec of y parses as a nested placeholder-less
+    # JoinedStr and must NOT be flagged (the fixer once stripped real
+    # f-strings because of this)
+    assert len(found) == 1 and found[0].line == 1
+
+
+# -- suppression + baseline mechanics -----------------------------------------
+
+
+# the marker is assembled at runtime so the repo-wide lint pass does not
+# read these fixture lines as suppressions of THIS file
+_MARK = "lint-" + "ok"
+
+
+def test_suppression_requires_reason():
+    src = f'''
+import os
+
+def fault():
+    return os.environ.get("RUSTPDE_FAULT")  # {_MARK}: RPD006
+'''
+    found = lint_source(src, "rustpde_mpi_tpu/utils/resilience.py")
+    assert "RPD000" in rules_of(found)  # bare suppression is itself flagged
+    assert "RPD006" in rules_of(found)  # and does not suppress
+
+
+def test_suppression_with_reason_suppresses():
+    src = f'''
+import os
+
+def fault():
+    return os.environ.get("RUSTPDE_FAULT")  # {_MARK}: RPD006 fixture exercises the raw read
+'''
+    found = lint_source(src, "rustpde_mpi_tpu/utils/resilience.py")
+    assert "RPD006" not in rules_of(found) and "RPD000" not in rules_of(found)
+
+
+def test_suppression_multi_rule_lists():
+    # space- AND comma-separated rule lists both suppress every listed rule
+    src = f'''
+import os
+
+def probe():
+    if is_root():
+        sync_hosts(os.environ.get("RUSTPDE_FAULT"))  # {_MARK}: RPD001 RPD006 fixture covers both
+'''
+    found = lint_source(src, "rustpde_mpi_tpu/serve/fixture.py")
+    assert "RPD001" not in rules_of(found) and "RPD006" not in rules_of(found)
+    # a bare multi-rule marker (no reason after the rule tokens) is RPD000
+    bare = src.replace("RPD001 RPD006 fixture covers both", "RPD001, RPD006")
+    found = lint_source(bare, "rustpde_mpi_tpu/serve/fixture.py")
+    assert "RPD000" in rules_of(found)
+    assert "RPD001" in rules_of(found)  # and nothing was suppressed
+
+
+# -- repo-wide contract -------------------------------------------------------
+
+
+def test_repo_is_lint_clean():
+    """HEAD carries zero unsuppressed findings and zero stale baseline
+    entries — the acceptance contract of scripts/lint.py (exit 0)."""
+    result = run_lint(root=REPO)
+    msgs = "\n".join(str(f) for f in result.new[:20])
+    assert not result.new, f"new lint findings:\n{msgs}"
+    stale = "\n".join(str(e) for e in result.stale_baseline[:10])
+    assert not result.stale_baseline, f"stale baseline entries:\n{stale}"
+    # every baseline entry carries a real written reason
+    for entry in core.load_baseline():
+        assert entry.get("reason") and "TODO" not in entry["reason"], entry
+
+
+# -- env-knob registry contract -----------------------------------------------
+
+_KNOB_RE = re.compile(r"RUSTPDE_[A-Z0-9_]+")
+
+
+def _grep_knob_names():
+    names = set()
+    files = core.collect_files(REPO) + ["__graft_entry__.py"]
+    for rel in files:
+        try:
+            with open(os.path.join(REPO, rel), encoding="utf-8") as fh:
+                names.update(_KNOB_RE.findall(fh.read()))
+        except OSError:
+            pass
+    return names
+
+
+def _readme_knob_names():
+    with open(os.path.join(REPO, "README.md"), encoding="utf-8") as fh:
+        text = fh.read()
+    start = text.index("## Environment knobs")
+    end = text.find("\n## ", start + 1)
+    section = text[start : end if end != -1 else len(text)]
+    return set(_KNOB_RE.findall(section))
+
+
+def test_every_knob_in_source_is_registered():
+    from rustpde_mpi_tpu import config
+
+    registered = set(config.env_knobs())
+    used = _grep_knob_names()
+    missing = used - registered
+    assert not missing, (
+        f"RUSTPDE_* knobs read in source but not registered in "
+        f"config.env_knobs(): {sorted(missing)}"
+    )
+
+
+def test_every_registered_knob_is_used_somewhere():
+    from rustpde_mpi_tpu import config
+
+    stale = set(config.env_knobs()) - _grep_knob_names()
+    assert not stale, f"registered knobs no longer read anywhere: {sorted(stale)}"
+
+
+def test_readme_knob_table_matches_registry():
+    from rustpde_mpi_tpu import config
+
+    registered = set(config.env_knobs())
+    documented = _readme_knob_names()
+    undocumented = registered - documented
+    assert not undocumented, (
+        f"knobs registered but missing from the README 'Environment knobs' "
+        f"table: {sorted(undocumented)}"
+    )
+    phantom = documented - registered
+    assert not phantom, (
+        f"README knob table rows without a registry entry: {sorted(phantom)}"
+    )
+
+
+def test_env_get_refuses_unregistered_names():
+    import pytest
+
+    from rustpde_mpi_tpu import config
+
+    # name built by concatenation so the registry-completeness grep above
+    # does not pick this negative fixture up as a "used" knob
+    with pytest.raises(config.UnregisteredKnobError):
+        config.env_get("RUSTPDE_" + "NOT_A_KNOB")
+    # non-RUSTPDE names pass through untouched (JAX_*, TPU_* stay raw)
+    assert config.env_get("JAX_NOT_A_KNOB", "x") == "x"

@@ -126,6 +126,7 @@ def test_sharded_two_phase_kill_then_resume(tmp_path):
             "RUSTPDE_SHARD_CRASH": "after_shard@10:host1",
             "RUSTPDE_SYNC_TIMEOUT_S": "30",
             "RUSTPDE_MP_BLOCKING_IO": "1",
+            "RUSTPDE_SANITIZE": "1",  # armed through the kill window too
         },
         check=False,  # rcs asserted per rank below (9 / nonzero expected)
     )
@@ -199,7 +200,6 @@ def test_multiprocess_serve_campaign_chaos_soak(tmp_path):
     reruns to the serve isolation tolerance."""
     import numpy as np
 
-    from rustpde_mpi_tpu.serve import DurableQueue
     from rustpde_mpi_tpu.utils.journal import read_journal
 
     out_dir = str(tmp_path / "mpserve")
@@ -209,6 +209,10 @@ def test_multiprocess_serve_campaign_chaos_soak(tmp_path):
         "RUSTPDE_MP_SERVE_REQUESTS": str(n_req),
         "RUSTPDE_SYNC_TIMEOUT_S": "60",
         "RUSTPDE_DISPATCH_TIMEOUT_S": "60",
+        # collective-sequence sanitizer armed through the whole chaos soak:
+        # any scheduler decision reaching a collective without the root
+        # plan trips a typed CollectiveDesyncError instead of passing
+        "RUSTPDE_SANITIZE": "1",
     }
 
     # phase 1: enqueue everything, drain at step 6 (SIGTERM on every host)

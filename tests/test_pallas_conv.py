@@ -251,7 +251,6 @@ def test_axis_operator_accessor():
                 fm = b._sep_dev(key)
                 ref = np.asarray(fm.apply(jnp.asarray(x), 0))
             else:
-                sp = Space2(b, b, method="matmul", sep=False)
                 if key == "fwd":
                     ref = np.asarray(b.forward(jnp.asarray(x), 0, "matmul"))
                 elif key == "bwd":

@@ -1,0 +1,6 @@
+"""Repo-level developer tooling (not shipped with the package).
+
+``tools.lint`` is the project-specific static-analysis layer — see
+``scripts/lint.py`` for the CLI and README "Static analysis & sanitizer"
+for the rule inventory.
+"""

@@ -1,0 +1,27 @@
+"""Project-specific static analysis for rustpde_mpi_tpu.
+
+Two layers, one CLI (``scripts/lint.py``):
+
+* **Project rules** (``project_rules.py``, ids ``RPD0xx``) — AST rules
+  distilled from this repo's own fixed-bug history: every rule encodes a
+  bug shape a past PR shipped and a review caught (see README "Static
+  analysis & sanitizer" for the rule -> historical bug table).
+* **Generic rules** (``generic_rules.py``, ids ``GEN-*``) — the curated
+  ruff subset this repo cares about (unused imports/locals, mutable
+  default args, f-strings without placeholders), run through ``ruff``
+  when it is installed and through a built-in AST fallback otherwise
+  (this container has no ruff and nothing may be pip-installed).
+
+Grandfathered findings live in ``tools/lint/baseline.json`` with a written
+reason each; new findings exit nonzero.  One-line inline suppression:
+``# lint-ok: RPD005 <reason>`` (a reason is mandatory — a bare suppression
+is itself a finding).
+"""
+
+from .core import (  # noqa: F401
+    Finding,
+    LintResult,
+    collect_files,
+    lint_source,
+    run_lint,
+)

@@ -1,0 +1,653 @@
+"""Project-specific AST rules (``RPD0xx``), distilled from this repo's own
+fixed-bug history — each rule encodes a shape a past PR shipped and review
+caught (README "Static analysis & sanitizer" has the rule -> bug table):
+
+* RPD001 — collective call reachable under a host-local condition that did
+  not go through ``root_decides``/``broadcast_obj`` (PR 10: the
+  ``_fill_slots`` drain check outside the root plan left one host's
+  collectives out of phase).  Both shapes are flagged: a collective inside
+  the conditional, and an early-exit (return/continue/break) under the
+  conditional with collectives later in the same function.
+* RPD002 — a collective on an exception path (``except``/``finally``): the
+  peer may be dead, the barrier wedges (PR 10 made exception exits skip
+  ``sync_hosts`` deliberately).
+* RPD003 — use of a buffer after it was passed to a ``donate_argnums``
+  position of a jitted callable (PR 1: ``update_n`` dispatches a fresh
+  copy so retained refs stay valid — donation invalidates the argument).
+* RPD004 — ``os.replace``/``os.rename`` without a parent-directory fsync in
+  a durability-critical module (PR 10 satellite: ``os.replace`` alone
+  leaves the dirent in page cache; the request-never-lost guarantee must
+  cover power loss).
+* RPD005 — ``np.asarray``/``np.array``/``jax.device_get`` on a possibly
+  sharded array in a multihost code path (PR 5 review: ``np.asarray(leaf)``
+  fetches non-addressable shards on the very platform the code targets).
+* RPD006 — raw ``os.environ`` read of a ``RUSTPDE_*`` knob outside
+  ``config.py``/``utils/faults.py``: every knob must be registered in
+  ``config.env_knobs()`` so the README knob table stays complete.
+* RPD007 — cross-module private-attribute reach (PR 8 review: HTTP
+  handlers reaching into ``sim._drain`` instead of a public surface).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+# ---------------------------------------------------------------- scoping
+
+PKG = "rustpde_mpi_tpu/"
+
+#: modules where collective-dispatch ordering across hosts matters
+MULTIHOST_MODULES = (
+    "rustpde_mpi_tpu/parallel/",
+    "rustpde_mpi_tpu/serve/",
+    "rustpde_mpi_tpu/utils/resilience.py",
+    "rustpde_mpi_tpu/utils/checkpoint.py",
+    "rustpde_mpi_tpu/utils/io_pipeline.py",
+    "rustpde_mpi_tpu/models/campaign.py",
+)
+
+#: modules whose on-disk state carries a durability guarantee
+DURABLE_MODULES = (
+    "rustpde_mpi_tpu/utils/checkpoint.py",
+    "rustpde_mpi_tpu/serve/queue.py",
+    "rustpde_mpi_tpu/utils/journal.py",
+    "rustpde_mpi_tpu/utils/io_pipeline.py",
+    "rustpde_mpi_tpu/utils/slice_io.py",
+)
+
+#: host-value collectives + the jit dispatch entry points every host must
+#: execute in lockstep (vmapped/scanned step dispatches, slot mutations)
+COLLECTIVE_CALLS = {
+    "sync_hosts",
+    "broadcast",
+    "broadcast_obj",
+    "allgather_host",
+    "root_decides",
+}
+DISPATCH_CALLS = {
+    "update_n",
+    "update_n_pending",
+    "set_member",
+    "mark_dead",
+    "respawn_dead",
+    "set_dt",
+    "write_sharded_snapshot",
+}
+
+#: going through one of these makes a host flag fleet-agreed (allgather
+#: returns the identical stacked array on every host)
+SANCTIONED_CALLS = {
+    "root_decides",
+    "broadcast",
+    "broadcast_obj",
+    "allgather_host",
+    "_drain_agreed",
+}
+
+_HOST_LOCAL_ATTR_RE = re.compile(r"(^|_)(drain|preempt|sig(nal|term|int)?)", re.I)
+_HOST_LOCAL_CALLS = {
+    "process_index",
+    "is_root",
+    "getenv",
+    "exists",
+    "isfile",
+    "isdir",
+    "glob",
+    "time",
+    "monotonic",
+    "perf_counter",
+    "random",
+    "uniform",
+    "randint",
+}
+
+
+def _in(relpath: str, prefixes) -> bool:
+    return any(relpath.startswith(p) for p in prefixes)
+
+
+def _call_name(node: ast.Call) -> str:
+    func = node.func
+    if isinstance(func, ast.Name):
+        return func.id
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    return ""
+
+
+def _contains_call(expr: ast.AST, names) -> bool:
+    return any(
+        isinstance(n, ast.Call) and _call_name(n) in names for n in ast.walk(expr)
+    )
+
+
+def _functions(tree):
+    """Yield (qualname, FunctionDef) for every function/method."""
+
+    def walk(node, prefix):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield f"{prefix}{child.name}", child
+                yield from walk(child, f"{prefix}{child.name}.")
+            elif isinstance(child, ast.ClassDef):
+                yield from walk(child, f"{prefix}{child.name}.")
+            else:
+                yield from walk(child, prefix)
+
+    yield from walk(tree, "")
+
+
+def _max_lineno(node: ast.AST) -> int:
+    return max(
+        (getattr(n, "lineno", 0) for n in ast.walk(node)), default=0
+    )
+
+
+# ------------------------------------------------- RPD001 host-local gating
+
+
+def _is_host_local(expr: ast.AST, tainted: set, cleared: set = frozenset()) -> bool:
+    """True when ``expr`` derives from a host-local source and was not
+    routed through a sanctioning broadcast.  ``cleared`` holds names that
+    were assigned from a sanctioning call — they beat the drain/preempt
+    name-pattern heuristic (``drain = root_decides(self._drain)`` is the
+    fixed form and must pass clean)."""
+    if _contains_call(expr, SANCTIONED_CALLS):
+        return False
+    for n in ast.walk(expr):
+        if isinstance(n, ast.Call) and _call_name(n) in _HOST_LOCAL_CALLS:
+            return True
+        if isinstance(n, ast.Attribute):
+            if n.attr == "environ" or _HOST_LOCAL_ATTR_RE.search(n.attr):
+                return True
+        if isinstance(n, ast.Name) and n.id not in cleared:
+            if n.id in tainted or _HOST_LOCAL_ATTR_RE.search(n.id):
+                return True
+    return False
+
+
+def rule_collective_under_host_local(module) -> list:
+    """RPD001 (the PR-10 drain-check shape)."""
+    if not _in(module.relpath, MULTIHOST_MODULES):
+        return []
+    out = []
+    collective = COLLECTIVE_CALLS | DISPATCH_CALLS
+    for qualname, fn in _functions(module.tree):
+        # linear taint pass: names assigned from host-local sources vs
+        # names explicitly routed through a sanctioning broadcast
+        tainted: set = set()
+        cleared: set = set()
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 and isinstance(
+                node.targets[0], ast.Name
+            ):
+                name = node.targets[0].id
+                if _contains_call(node.value, SANCTIONED_CALLS):
+                    tainted.discard(name)
+                    cleared.add(name)
+                elif _is_host_local(node.value, tainted, cleared):
+                    tainted.add(name)
+                    cleared.discard(name)
+        # collective call sites in this function, by line
+        call_lines = [
+            n.lineno
+            for n in ast.walk(fn)
+            if isinstance(n, ast.Call) and _call_name(n) in collective
+        ]
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.If):
+                continue
+            if not _is_host_local(node.test, tainted, cleared):
+                continue
+            branch_nodes = node.body + node.orelse
+            # shape (a): collective inside the host-local conditional
+            for stmt in branch_nodes:
+                for n in ast.walk(stmt):
+                    if isinstance(n, ast.Call) and _call_name(n) in collective:
+                        out.append(
+                            module.finding(
+                                "RPD001",
+                                n,
+                                f"collective/dispatch call '{_call_name(n)}' under a "
+                                "host-local condition — route the decision through "
+                                "root_decides/broadcast_obj first",
+                                qualname,
+                            )
+                        )
+            # shape (b): early-exit under the conditional, collectives later
+            has_exit = any(
+                isinstance(n, (ast.Return, ast.Continue, ast.Break))
+                for stmt in branch_nodes
+                for n in ast.walk(stmt)
+            )
+            if has_exit:
+                end = _max_lineno(node)
+                if any(line > end for line in call_lines):
+                    out.append(
+                        module.finding(
+                            "RPD001",
+                            node,
+                            "early-exit under a host-local condition skips the "
+                            "collective calls below on THIS host only — hoist the "
+                            "decision into the root plan (root_decides/broadcast_obj)",
+                            qualname,
+                        )
+                    )
+    return out
+
+
+# ------------------------------------------------ RPD002 sync on except
+
+
+def rule_collective_on_exception_path(module) -> list:
+    if not module.relpath.startswith(PKG):
+        return []
+    out = []
+    for qualname, fn in _functions(module.tree):
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Try):
+                continue
+            regions = [(h.body, "except") for h in node.handlers]
+            regions.append((node.finalbody, "finally"))
+            for body, kind in regions:
+                for stmt in body:
+                    for n in ast.walk(stmt):
+                        if isinstance(n, ast.Call) and _call_name(n) in COLLECTIVE_CALLS:
+                            out.append(
+                                module.finding(
+                                    "RPD002",
+                                    n,
+                                    f"collective '{_call_name(n)}' on a {kind} path — "
+                                    "the peer may be dead; exception exits must skip "
+                                    "barriers (journaled structured exit instead)",
+                                    qualname,
+                                )
+                            )
+    return out
+
+
+# ------------------------------------------------ RPD003 use after donate
+
+
+def _donated_positions(call: ast.Call):
+    """``jax.jit(..., donate_argnums=...)`` -> set of donated positions."""
+    if _call_name(call) != "jit":
+        return None
+    for kw in call.keywords:
+        if kw.arg == "donate_argnums":
+            v = kw.value
+            if isinstance(v, ast.Constant) and isinstance(v.value, int):
+                return {v.value}
+            if isinstance(v, (ast.Tuple, ast.List)):
+                vals = set()
+                for elt in v.elts:
+                    if isinstance(elt, ast.Constant) and isinstance(elt.value, int):
+                        vals.add(elt.value)
+                return vals
+    return None
+
+
+def _target_key(node):
+    if isinstance(node, ast.Name):
+        return ("name", node.id)
+    if (
+        isinstance(node, ast.Attribute)
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "self"
+    ):
+        return ("self", node.attr)
+    return None
+
+
+def rule_use_after_donate(module) -> list:
+    if not module.relpath.startswith(PKG):
+        return []
+    # pass 1: donated callables bound to locals or self attributes
+    donated: dict = {}
+    for node in ast.walk(module.tree):
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            pos = _donated_positions(node.value)
+            if pos:
+                for tgt in node.targets:
+                    key = _target_key(tgt)
+                    if key:
+                        donated[key] = pos
+    if not donated:
+        return []
+    out = []
+    for qualname, fn in _functions(module.tree):
+        consumed: dict[str, int] = {}  # name -> line it was donated on
+
+        def scan(node):
+            if isinstance(node, (ast.Lambda, ast.FunctionDef, ast.AsyncFunctionDef)):
+                return  # own scope: params shadow, nested defs get their own pass
+            if isinstance(node, ast.Call):
+                # argument loads happen at call evaluation, BEFORE the
+                # donation invalidates the buffer — scan children first
+                for child in ast.iter_child_nodes(node):
+                    scan(child)
+                key = _target_key(node.func)
+                if key in donated:
+                    for i, arg in enumerate(node.args):
+                        if i in donated[key] and isinstance(arg, ast.Name):
+                            consumed[arg.id] = node.lineno
+                return
+            if isinstance(node, ast.Name):
+                if isinstance(node.ctx, ast.Load) and node.id in consumed:
+                    out.append(
+                        module.finding(
+                            "RPD003",
+                            node,
+                            f"'{node.id}' used after being passed to a "
+                            f"donate_argnums position (donated at line "
+                            f"{consumed[node.id]}) — the buffer is invalidated; "
+                            "dispatch a fresh copy or re-bind the result",
+                            qualname,
+                        )
+                    )
+                elif isinstance(node.ctx, ast.Store):
+                    consumed.pop(node.id, None)
+                return
+            if isinstance(node, ast.Assign):
+                scan(node.value)  # RHS consumes before LHS re-binds
+                for tgt in node.targets:
+                    scan(tgt)
+                return
+            for child in ast.iter_child_nodes(node):
+                scan(child)
+
+        for stmt in fn.body:
+            scan(stmt)
+    return out
+
+
+# --------------------------------------------- RPD004 replace w/o dirsync
+
+
+def rule_replace_without_dirsync(module) -> list:
+    if not _in(module.relpath, DURABLE_MODULES):
+        return []
+    out = []
+    for qualname, fn in _functions(module.tree):
+        has_dirsync = any(
+            isinstance(n, ast.Call) and "fsync_dir" in _call_name(n)
+            for n in ast.walk(fn)
+        )
+        if has_dirsync:
+            continue
+        for n in ast.walk(fn):
+            if (
+                isinstance(n, ast.Call)
+                and isinstance(n.func, ast.Attribute)
+                and isinstance(n.func.value, ast.Name)
+                and n.func.value.id == "os"
+                and n.func.attr in ("replace", "rename")
+            ):
+                out.append(
+                    module.finding(
+                        "RPD004",
+                        n,
+                        f"os.{n.func.attr} in a durability-critical module without a "
+                        "parent-directory fsync — the dirent stays in page cache "
+                        "across power loss; call utils.fsutil.fsync_dir after the "
+                        "rename",
+                        qualname,
+                    )
+                )
+    return out
+
+
+# ----------------------------------------- RPD005 asarray on sharded array
+
+
+_HOST_SAFE_CALLS = {
+    "allgather_host",
+    "host_local_array",
+    "process_allgather",
+    "addressable_data",
+}
+
+
+def _arg_is_host_safe(arg: ast.AST) -> bool:
+    if isinstance(arg, (ast.Constant, ast.List, ast.Tuple, ast.Dict)):
+        return True
+    # h5py/dict subscripts (``h5["time"]``) are host-side reads, and
+    # float()/int()/len() casts force a host scalar before asarray sees it
+    if isinstance(arg, ast.Subscript):
+        return True
+    if isinstance(arg, ast.Call):
+        name = _call_name(arg)
+        if name in _HOST_SAFE_CALLS:
+            return True
+        if isinstance(arg.func, ast.Name) and arg.func.id in (
+            "float",
+            "int",
+            "bool",
+            "len",
+            "str",
+            "bytes",
+        ):
+            return True
+        # np.*(...) / numpy.*(...) construct host arrays
+        f = arg.func
+        if isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name) and f.value.id in (
+            "np",
+            "numpy",
+        ):
+            return True
+    for n in ast.walk(arg):
+        if isinstance(n, ast.Attribute) and n.attr in (
+            "addressable_shards",
+            "addressable_data",
+        ):
+            return True
+    return False
+
+
+def rule_asarray_on_sharded(module) -> list:
+    scope = (
+        "rustpde_mpi_tpu/parallel/multihost.py",
+        "rustpde_mpi_tpu/utils/checkpoint.py",
+        "rustpde_mpi_tpu/utils/resilience.py",
+        "rustpde_mpi_tpu/utils/io_pipeline.py",
+        "rustpde_mpi_tpu/serve/",
+        "rustpde_mpi_tpu/models/campaign.py",
+    )
+    if not _in(module.relpath, scope):
+        return []
+    out = []
+    for qualname, fn in _functions(module.tree):
+        for n in ast.walk(fn):
+            if not isinstance(n, ast.Call):
+                continue
+            f = n.func
+            fetch = None
+            if isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name):
+                if f.value.id in ("np", "numpy") and f.attr in ("asarray", "array"):
+                    fetch = f"np.{f.attr}"
+                if f.value.id == "jax" and f.attr == "device_get":
+                    fetch = "jax.device_get"
+            if fetch is None or not n.args:
+                continue
+            if _arg_is_host_safe(n.args[0]):
+                continue
+            out.append(
+                module.finding(
+                    "RPD005",
+                    n,
+                    f"{fetch} on a possibly-sharded array in a multihost code "
+                    "path — fetches non-addressable shards (PR-5 bug shape); use "
+                    "addressable_shards/host_local_array or build from dtype "
+                    "metadata, or mark the value '# lint-ok: RPD005 <why host-"
+                    "local>'",
+                    qualname,
+                )
+            )
+    return out
+
+
+# --------------------------------------------------- RPD006 raw env reads
+
+
+def rule_raw_env_read(module) -> list:
+    if not module.relpath.startswith(PKG):
+        return []
+    if module.relpath in (
+        "rustpde_mpi_tpu/config.py",  # the registry itself
+        "rustpde_mpi_tpu/utils/faults.py",  # import-light by design (no jax)
+    ):
+        return []
+    out = []
+    for qualname, fn in _functions(module.tree):
+        for n in ast.walk(fn):
+            key = None
+            if isinstance(n, ast.Call):
+                name = _call_name(n)
+                if name in ("get", "getenv") and n.args:
+                    target = n.func
+                    is_env = name == "getenv" or (
+                        isinstance(target, ast.Attribute)
+                        and isinstance(target.value, ast.Attribute)
+                        and target.value.attr == "environ"
+                    )
+                    if is_env and isinstance(n.args[0], ast.Constant):
+                        key = n.args[0].value
+            elif isinstance(n, ast.Subscript) and isinstance(n.ctx, ast.Load):
+                v = n.value
+                if isinstance(v, ast.Attribute) and v.attr == "environ":
+                    if isinstance(n.slice, ast.Constant):
+                        key = n.slice.value
+            if isinstance(key, str) and key.startswith("RUSTPDE_"):
+                out.append(
+                    module.finding(
+                        "RPD006",
+                        n,
+                        f"raw os.environ read of {key!r} outside config.py — go "
+                        "through config.env_get so the knob is registered in "
+                        "env_knobs() and the README knob table stays complete",
+                        qualname,
+                    )
+                )
+    # module-level reads (outside any function)
+    return out + _module_level_env_reads(module)
+
+
+def _module_level_env_reads(module) -> list:
+    out = []
+    fn_ranges = []
+    for _, fn in _functions(module.tree):
+        fn_ranges.append((fn.lineno, _max_lineno(fn)))
+
+    def in_fn(line):
+        return any(a <= line <= b for a, b in fn_ranges)
+
+    for n in ast.walk(module.tree):
+        if in_fn(getattr(n, "lineno", 0)):
+            continue
+        key = None
+        if isinstance(n, ast.Call):
+            name = _call_name(n)
+            if name in ("get", "getenv") and n.args:
+                target = n.func
+                is_env = name == "getenv" or (
+                    isinstance(target, ast.Attribute)
+                    and isinstance(target.value, ast.Attribute)
+                    and target.value.attr == "environ"
+                )
+                if is_env and isinstance(n.args[0], ast.Constant):
+                    key = n.args[0].value
+        elif isinstance(n, ast.Subscript) and isinstance(n.ctx, ast.Load):
+            v = n.value
+            if isinstance(v, ast.Attribute) and v.attr == "environ":
+                if isinstance(n.slice, ast.Constant):
+                    key = n.slice.value
+        if isinstance(key, str) and key.startswith("RUSTPDE_"):
+            out.append(
+                module.finding(
+                    "RPD006",
+                    n,
+                    f"raw os.environ read of {key!r} at module "
+                    "level — go through config.env_get",
+                )
+            )
+    return out
+
+
+# ------------------------------------------- RPD007 cross-module privates
+
+
+_NAMEDTUPLE_OK = {"_fields", "_replace", "_asdict", "_make", "_field_defaults"}
+
+
+def rule_cross_module_private(module) -> list:
+    if not module.relpath.startswith(PKG):
+        return []
+    imported_modules: set = set()
+    imported_symbols: set = set()
+    for node in ast.walk(module.tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                # only the package's own modules: stdlib privates
+                # (sys._getframe, os._exit) are established idioms
+                if alias.name.startswith("rustpde"):
+                    imported_modules.add(alias.asname or alias.name.split(".")[0])
+        elif isinstance(node, ast.ImportFrom) and node.module != "__future__":
+            if node.level == 0 and not (node.module or "").startswith("rustpde"):
+                continue
+            for alias in node.names:
+                imported_symbols.add(alias.asname or alias.name)
+    out = []
+    for qualname, fn in _functions(module.tree):
+        # locals constructed from imported classes: v = ImportedThing(...)
+        constructed: set = set()
+        for n in ast.walk(fn):
+            if (
+                isinstance(n, ast.Assign)
+                and len(n.targets) == 1
+                and isinstance(n.targets[0], ast.Name)
+                and isinstance(n.value, ast.Call)
+                and isinstance(n.value.func, ast.Name)
+                and n.value.func.id in imported_symbols
+                and n.value.func.id[:1].isupper()
+            ):
+                constructed.add(n.targets[0].id)
+        for n in ast.walk(fn):
+            if not isinstance(n, ast.Attribute):
+                continue
+            attr = n.attr
+            if (
+                not attr.startswith("_")
+                or attr.startswith("__")
+                or attr in _NAMEDTUPLE_OK
+            ):
+                continue
+            base = n.value
+            if not isinstance(base, ast.Name) or base.id in ("self", "cls"):
+                continue
+            if (
+                base.id in imported_modules
+                or base.id in imported_symbols
+                or base.id in constructed
+            ):
+                out.append(
+                    module.finding(
+                        "RPD007",
+                        n,
+                        f"cross-module reach into private '{base.id}.{attr}' — "
+                        "promote a public accessor on the owning module instead",
+                        qualname,
+                    )
+                )
+    return out
+
+
+RULES = (
+    rule_collective_under_host_local,
+    rule_collective_on_exception_path,
+    rule_use_after_donate,
+    rule_replace_without_dirsync,
+    rule_asarray_on_sharded,
+    rule_raw_env_read,
+    rule_cross_module_private,
+)
