@@ -363,24 +363,39 @@ def bench_governor(nx, ny, ra, dt, steps):
             )
         # save/restore each layer's own flag: restoring both from the
         # metrics flag would re-enable tracing a user pinned off via
-        # RUSTPDE_TRACE=0
-        tel_prev = (telemetry.metrics_enabled(), telemetry.tracing_enabled())
+        # RUSTPDE_TRACE=0.  The reqtrace layer rides the same master
+        # switch, and a fake slot binding keeps the span-annotator path
+        # HOT through the ON legs — the 2% gate covers the reqtrace path,
+        # not just bare spans (ISSUE 13 extension of the PR-8 contract).
+        from rustpde_mpi_tpu.telemetry import reqtrace as _reqtrace
+
+        tel_prev = (
+            telemetry.metrics_enabled(),
+            telemetry.tracing_enabled(),
+            telemetry.reqtrace_enabled(),
+        )
         tel_walls = {"on": [], "off": []}
         try:
             for key, r in runners.items():  # compile + warm the chunk shapes
                 telemetry.set_enabled(key == "on")
+                _reqtrace.bind_slots({0: "benchtrace0000"} if key == "on" else {})
                 r.advance(tel_window)
                 _jax.block_until_ready(r.pde.state)
             for _ in range(5):
                 for key, r in runners.items():
                     telemetry.set_enabled(key == "on")
+                    _reqtrace.bind_slots(
+                        {0: "benchtrace0000"} if key == "on" else {}
+                    )
                     t0 = time.perf_counter()
                     r.advance(tel_window)
                     _jax.block_until_ready(r.pde.state)
                     tel_walls[key].append(time.perf_counter() - t0)
         finally:
+            _reqtrace.clear_active()
             telemetry.set_metrics_enabled(tel_prev[0])
             telemetry.set_tracing_enabled(tel_prev[1])
+            telemetry.set_reqtrace_enabled(tel_prev[2])
         tel_overhead = min(tel_walls["on"]) / min(tel_walls["off"]) - 1.0
         # bit-equality: both runners stepped the identical IC the identical
         # number of steps — telemetry must not have changed a single bit
@@ -960,6 +975,63 @@ def bench_serve(nx=129, ny=129, ra=1e7, dt=2e-3, steps_per_req=8):
         finally:
             shutil.rmtree(mp_dir, ignore_errors=True)
 
+        # observability attribution (ISSUE 13): the service-root
+        # metrics.jsonl (root's force-dump at server stop) carries the
+        # admission-to-first-observable histogram and the per-bucket MFU /
+        # time-to-first-chunk series of the LAST incarnation; the journal's
+        # compile_build rows give cross-incarnation recompile counts
+        from rustpde_mpi_tpu.telemetry import read_metrics_jsonl
+
+        journal_rows = read_journal(os.path.join(run_dir, "journal.jsonl"))
+        builds_by_key: dict = {}
+        for row in journal_rows:
+            if row.get("event") == "compile_build":
+                tag = row.get("key_tag", "?")
+                cur = builds_by_key.setdefault(
+                    tag, {"builds": 0, "wall_s_sum": 0.0}
+                )
+                cur["builds"] += 1
+                cur["wall_s_sum"] = round(
+                    cur["wall_s_sum"] + float(row.get("wall_s", 0.0)), 4
+                )
+        for cur in builds_by_key.values():
+            cur["recompiles"] = cur["builds"] - 1
+        obs: dict = {"compile": builds_by_key}
+        tel_rows = read_metrics_jsonl(os.path.join(run_dir, "metrics.jsonl"))
+        admission_p50 = admission_p99 = None
+        if tel_rows:
+            snap = tel_rows[-1].get("snapshot", {})
+
+            def series(name):
+                return snap.get(name, {}).get("series", [])
+
+            hist = next(
+                iter(series("serve_admission_to_first_observable_seconds")),
+                None,
+            )
+            if hist:
+                admission_p50 = hist.get("p50")
+                admission_p99 = hist.get("p99")
+            obs["time_to_first_chunk_s"] = {
+                s.get("labels", {}).get("key", "?"): {
+                    "count": s.get("count"),
+                    "p50": s.get("p50"),
+                    "max": s.get("max"),
+                }
+                for s in series("serve_time_to_first_chunk_seconds")
+            }
+            obs["bucket_mfu"] = {
+                s.get("labels", {}).get("bucket", "?"): s.get("value")
+                for s in series("serve_mfu")
+            }
+            obs["fleet_utilization_final"] = next(
+                (s.get("value") for s in series("serve_fleet_utilization")),
+                None,
+            )
+        obs["traces_assembled"] = sum(
+            1 for row in journal_rows if row.get("event") == "campaign_trace"
+        )
+
         lat = np.sort(np.asarray(latencies)) if latencies else np.zeros(1)
         pct = lambda p: float(lat[min(len(lat) - 1, int(p / 100 * len(lat)))])
         member_steps = s1.get("member_steps", 0) + s2.get("member_steps", 0)
@@ -992,6 +1064,14 @@ def bench_serve(nx=129, ny=129, ra=1e7, dt=2e-3, steps_per_req=8):
             "latency_p90_s": pct(90),
             "latency_p99_s": pct(99),
             "latency_mean_s": float(np.mean(lat)),
+            # the HA front-door gate metric (log-bucket approximate):
+            # durable-queue enqueue to first streamed observable
+            "admission_to_first_observable_p50_s": admission_p50,
+            "admission_to_first_observable_p99_s": admission_p99,
+            # compile/device attribution (ISSUE 13): per-compat-key build
+            # walls + cross-incarnation recompiles, time-to-first-chunk,
+            # per-bucket MFU, assembled campaign trace files
+            "observability": obs,
             "isolation_max_rel_diff": max(iso_diffs) if iso_diffs else None,
             "phase_wall_s": [round(wall1, 1), round(wall2, 1)],
             "multiprocess": mp,

@@ -121,6 +121,15 @@ register_knob("RUSTPDE_TELEMETRY", "1", "telemetry master switch")
 register_knob("RUSTPDE_TRACE", "1", "flight-recorder span tracing switch")
 register_knob("RUSTPDE_TRACE_EVENTS", "4096", "flight-recorder ring capacity")
 register_knob("RUSTPDE_METRICS_DUMP_S", "60", "metrics.jsonl dump cadence")
+register_knob("RUSTPDE_REQTRACE", "1",
+              "per-request distributed tracing switch (trace ids still mint)")
+register_knob("RUSTPDE_REQTRACE_EVENTS", "16384",
+              "request-trace per-process event capacity per campaign")
+register_knob("RUSTPDE_PROFILE_MAX_S", "60",
+              "cap on one POST /profile (or perf_degraded auto) capture")
+register_knob("RUSTPDE_TREND_BAND", "0.3",
+              "bench_trend noise band: regression when below (1-band)*best",
+              "bench")
 # resilience / watchdogs / fault injection
 register_knob("RUSTPDE_DISPATCH_TIMEOUT_S", None, "device-dispatch hang watchdog")
 register_knob("RUSTPDE_SYNC_TIMEOUT_S", "0",

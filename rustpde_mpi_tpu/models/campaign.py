@@ -218,7 +218,26 @@ class CampaignModelBase:
         """Hoist + jit the step/observables entry points (see Navier2D's
         original docstring: closure-converted constants keep the HLO small
         at large grids) and build the chunked ``step_n`` with the in-chunk
-        early-exit and buffer donation."""
+        early-exit and buffer donation.
+
+        The wall time of every pass through here is recorded per model
+        kind (telemetry/compile_log.py): dt-ladder re-jits and restores
+        re-enter this seam without a model rebuild, and the cold-start
+        ROADMAP item needs that attribution separated from build time."""
+        import time as _time
+
+        from ..telemetry import compile_log
+
+        t0 = _time.perf_counter()
+        try:
+            self._compile_entry_points_impl()
+        finally:
+            compile_log.observe_entry_compile(
+                str(getattr(self, "MODEL_KIND", type(self).__name__)),
+                _time.perf_counter() - t0,
+            )
+
+    def _compile_entry_points_impl(self) -> None:
         import jax
         import jax.numpy as jnp
 

@@ -233,6 +233,25 @@ class NavierEnsemble(Integrate):
         return self.model.observable_names
 
     def _compile_entry_points(self) -> None:
+        # same attribution seam as the base model's entry-point compile
+        # (models/campaign.py): the K-member vmap trace is the serving
+        # path's dominant build cost and is re-entered by set_dt/
+        # set_stability without a model rebuild — it must not vanish from
+        # the per-kind compile metrics
+        import time as _time
+
+        from ..telemetry import compile_log as _compile_log
+
+        t0 = _time.perf_counter()
+        try:
+            self._compile_entry_points_impl()
+        finally:
+            _compile_log.observe_entry_compile(
+                f"ensemble:{getattr(self.model, 'MODEL_KIND', 'model')}",
+                _time.perf_counter() - t0,
+            )
+
+    def _compile_entry_points_impl(self) -> None:
         model = self.model
         step_cc = model._step_cc
         obs_cc = model._obs_cc

@@ -489,11 +489,13 @@ class Navier2DLnse(CampaignModelBase, Integrate):
         bounds the rebuild cost."""
         self.navier.set_dt(dt)
 
-    def _compile_entry_points(self) -> None:
+    def _compile_entry_points_impl(self) -> None:
         """The campaign entry points (hoisted ``_step_cc``/``_obs_cc``,
         chunked scans, sentinels — CampaignModelBase) plus the lnse-specific
-        ADJOINT loop entries of the linearized model."""
-        super()._compile_entry_points()
+        ADJOINT loop entries of the linearized model.  Overrides the IMPL
+        hook (not the timed wrapper), so the per-kind compile attribution
+        covers the adjoint-loop hoist+jit too."""
+        super()._compile_entry_points_impl()
         if self.NONLINEAR:
             return
         from ..utils.jit import hoist_constants
@@ -788,8 +790,10 @@ class Navier2DNonLin(Navier2DLnse):
 
     NONLINEAR = True
 
-    def _compile_entry_points(self) -> None:
-        super()._compile_entry_points()
+    def _compile_entry_points_impl(self) -> None:
+        # impl-hook override (see the linear model's note): the nonlinear
+        # trajectory-recording entries stay inside the timed attribution
+        super()._compile_entry_points_impl()
         nav = self.navier
         example = jax.tree.map(
             lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), NavierState(*nav.state)

@@ -224,6 +224,26 @@ def broadcast(value, is_source: bool | None = None):
     return out
 
 
+def allgather_bytes(data: bytes) -> list[bytes]:
+    """Allgather one variable-length byte blob per host: every host returns
+    ``[host0_bytes, host1_bytes, ...]`` in rank order.  Two allgathers ride
+    underneath — a length exchange, then a padded uint8 buffer — because
+    ``process_allgather`` needs identical shapes on every host.  The
+    telemetry layer's fleet aggregation (metrics snapshots, request-trace
+    gathers) rides this one primitive.  Single-host: ``[data]``."""
+    if jax.process_count() == 1:
+        return [bytes(data)]
+    blob = np.frombuffer(bytes(data), np.uint8)
+    lengths = allgather_host(np.int64(blob.size))
+    width = max(1, int(lengths.max()))
+    padded = np.zeros(width, np.uint8)
+    padded[: blob.size] = blob
+    stack = allgather_host(padded)
+    return [
+        bytes(stack[i, : int(lengths[i])]) for i in range(stack.shape[0])
+    ]
+
+
 def broadcast_obj(obj=None):
     """Root-decides broadcast of an arbitrary JSON-able host object (the
     serve scheduler's per-boundary decision plans: bucket keys, slot

@@ -4,17 +4,26 @@ A :class:`~http.server.ThreadingHTTPServer` on a daemon thread, speaking a
 six-endpoint JSON protocol over the :class:`~.scheduler.SimServer`'s
 thread-safe surface::
 
-    POST /requests        {"ra":1e4,"horizon":0.1,...}  -> 202 {"id": ...}
+    POST /requests        {"ra":1e4,"horizon":0.1,...}  -> 202 {"id", "steps",
+                          "trace_id"} — the trace id names the request's
+                          whole lifecycle across restarts
                           429 {"error","reason"} on admission rejection
                           400 on a malformed request body / bad
                           Content-Length / truncated body, 413 oversized
     GET  /requests/<id>   lifecycle record               (404 unknown)
+    GET  /requests/<id>/trace  the request's assembled Perfetto timeline
+                          (admission -> queued -> scheduled -> chunks ->
+                          re-bucket -> done, across incarnations) — load it
+                          straight into ui.perfetto.dev  (404 unknown)
     GET  /stats           queue counts + throughput counters
     GET  /healthz         {"ok", "draining", "queue", "slots"} — liveness
                           plus queue depth and slot utilization, so an
                           orchestrator can see back-pressure, not just "up"
     GET  /metrics         Prometheus text exposition of the live registry
                           (telemetry/exporters.py) — point a scraper here
+    POST /profile?seconds=N   on-demand jax.profiler capture into
+                          <run_dir>/profiles (RUSTPDE_PROFILE_MAX_S cap,
+                          single-flight: 409 while one runs, 400 bad args)
     POST /drain           ask the service to drain       -> 202
 
 Durability lives BELOW this layer: a submit is acknowledged only after the
@@ -122,7 +131,16 @@ class HttpFront:
                 if self.path == "/stats":
                     return self._reply(200, sim.stats())
                 if self.path.startswith("/requests/"):
-                    status = sim.status(self.path.rsplit("/", 1)[-1])
+                    parts = self.path.strip("/").split("/")
+                    if len(parts) == 3 and parts[2] == "trace":
+                        trace = sim.request_trace(parts[1])
+                        if trace is None:
+                            return self._reply(
+                                404, {"error": "unknown request id (or no "
+                                              "trace recorded for it)"}
+                            )
+                        return self._reply(200, trace)
+                    status = sim.status(parts[-1])
                     if status is None:
                         return self._reply(404, {"error": "unknown request id"})
                     return self._reply(200, status)
@@ -163,6 +181,22 @@ class HttpFront:
                 if self.path == "/drain":
                     sim.request_drain()
                     return self._reply(202, {"draining": True})
+                if self.path.split("?", 1)[0] == "/profile":
+                    from urllib.parse import parse_qs, urlsplit
+
+                    query = parse_qs(urlsplit(self.path).query)
+                    seconds = (query.get("seconds") or ["5"])[0]
+                    try:
+                        seconds = float(seconds)
+                    except ValueError:
+                        return self._reply(
+                            400, {"error": f"bad seconds {seconds!r}"}
+                        )
+                    status = sim.profile_capture(seconds)
+                    if status.get("started"):
+                        return self._reply(202, status)
+                    code = 409 if "already running" in status.get("error", "") else 400
+                    return self._reply(code, status)
                 if self.path != "/requests":
                     return self._reply(404, {"error": "unknown endpoint"})
                 body, err = self._read_body()
@@ -178,6 +212,9 @@ class HttpFront:
                     )
                 except (RequestError, ValueError, TypeError) as exc:
                     return self._reply(400, {"error": str(exc)})
-                return self._reply(202, {"id": req.id, "steps": req.steps})
+                return self._reply(
+                    202,
+                    {"id": req.id, "steps": req.steps, "trace_id": req.trace_id},
+                )
 
         return Handler

@@ -95,7 +95,11 @@ class DurableQueue:
         return req
 
     def _enqueue(self, req: SimRequest) -> None:
-        """Write the queued file (caller holds the lock)."""
+        """Write the queued file (caller holds the lock).  The FIRST durable
+        enqueue stamps ``enqueued_s`` — the admission-to-first-observable
+        histogram's clock start; requeues (drain/retry/re-bucket) keep it."""
+        if not req.enqueued_s:
+            req.enqueued_s = time.time()
         self._seq += 1
         name = f"{time.time_ns():020d}{self._seq:04d}-{req.id}.json"
         _atomic_write(os.path.join(self._dir("queued"), name), req.to_json())

@@ -98,9 +98,14 @@ class SimRequest:
     amp: float | None = None  # IC amplitude (None: ServeConfig.default_amp)
     id: str = ""
     submitted_s: float = 0.0  # unix time at admission (latency accounting)
+    enqueued_s: float = 0.0  # unix time of the FIRST durable enqueue
     retries: int = 0  # divergence retries consumed
     dts: list = dataclasses.field(default_factory=list)  # dt trajectory
     progress: int = 0  # steps completed before the last drain/requeue
+    # distributed trace context (telemetry/reqtrace.mint): trace_id names
+    # the request's whole lifecycle across retries/re-buckets/incarnations;
+    # riding the durable request file it survives exactly what the id does
+    trace: dict | None = None
 
     def __post_init__(self):
         if not self.id:
@@ -109,6 +114,15 @@ class SimRequest:
             self.submitted_s = time.time()
         if not self.dts:
             self.dts = [float(self.dt)]
+        if self.trace is None:
+            from ..telemetry import reqtrace
+
+            self.trace = reqtrace.mint(self.id)
+
+    @property
+    def trace_id(self) -> str | None:
+        """The lifecycle trace id (journal rows and chunk spans carry it)."""
+        return (self.trace or {}).get("trace_id")
 
     def validate(self) -> "SimRequest":
         """Admission-time sanity: reject malformed work before it costs a

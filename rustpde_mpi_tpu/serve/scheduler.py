@@ -92,7 +92,9 @@ import numpy as np
 
 from ..config import IOConfig, ServeConfig, env_get
 from ..models.ensemble import NavierEnsemble
+from ..telemetry import compile_log as _cl
 from ..telemetry import metrics as _tm
+from ..telemetry import reqtrace as _rt
 from ..telemetry import tracing as _tr
 from ..telemetry.exporters import MetricsDumper
 from ..utils import checkpoint
@@ -196,6 +198,12 @@ class SimServer:
         self._slots_state: tuple[int, int] = (0, int(self.cfg.slots))
         self._rate_mark: tuple[float, int] = (time.monotonic(), 0)
         self._flops_member: float | None = None
+        # compile/device attribution bookkeeping (telemetry/compile_log):
+        # the active bucket's label, the campaign-open stamp the
+        # time-to-first-chunk histogram measures from, and its one-shot flag
+        self._bucket_tag = ""
+        self._campaign_open = time.monotonic()
+        self._first_chunk_done = True
         # parked mid-flight member states: request id -> (state pytree,
         # steps completed, sim time completed).  An elastic shrink or a dt
         # re-bucket releases a lane but keeps the trajectory — the next
@@ -285,6 +293,7 @@ class SimServer:
             {
                 "event": "request_admitted",
                 "id": req.id,
+                "trace_id": req.trace_id,
                 "key": list(req.compat_key),
                 "steps": req.steps,
                 "queued": queued,
@@ -314,6 +323,23 @@ class SimServer:
             err = record["error"]
             raise RequestFailed(request_id, err["reason"], err.get("dts", ()))
         return None
+
+    def request_trace(self, request_id: str) -> dict | None:
+        """One request's assembled Perfetto timeline (admission → queued →
+        scheduled → chunks → re-bucket → done, across incarnations) from
+        durable state alone — ``GET /requests/<id>/trace`` serves this.
+        None for an unknown request; thread-safe (reads files only)."""
+        return _rt.assemble_request_trace(self.cfg.run_dir, request_id)
+
+    def profile_capture(self, seconds: float = 5.0) -> dict:
+        """Start an on-demand ``jax.profiler`` capture into
+        ``<run_dir>/profiles/`` (``POST /profile?seconds=N``); bounded by
+        ``RUSTPDE_PROFILE_MAX_S``, single-flight (a second request while
+        one runs is refused in the status payload)."""
+        logdir = os.path.join(self.cfg.run_dir, "profiles", "manual")
+        status = _cl.CAPTURE.start(logdir, seconds, reason="http")
+        self._journal({"event": "profile_capture", **status})
+        return status
 
     def request_drain(self) -> None:
         """Ask the service to drain: stop admitting, checkpoint in-flight
@@ -588,7 +614,10 @@ class SimServer:
         # (DNS with/without modifiers, lnse, adjoint); on a multi-process
         # runtime the model spans the global pencil mesh, so campaign
         # dispatches are the same collective SPMD programs the runner's
-        # standalone multihost runs execute
+        # standalone multihost runs execute.  The build seam records the
+        # per-compat-key compile attribution (telemetry/compile_log.py);
+        # the journal row here is the durable copy of that observation.
+        t_build = time.perf_counter()
         model = build_model_for_key(key, mesh=self._campaign_mesh())
         model.write_intervall = float("inf")  # no flow-file callback IO
         if self.cfg.stability is not None:
@@ -608,6 +637,21 @@ class SimServer:
         k = int(self.cfg.slots if k is None else k)
         ens = _ServedEnsemble(model, [model.state] * k)
         ens.mark_dead(range(ens.k))  # all lanes idle until a request lands
+        # the compile_build journal row covers the WHOLE campaign build
+        # window — base model (registry seam), armed sentinels and the
+        # K-member ensemble trace — the serving path's real cold cost, not
+        # just the single-model constructor
+        builds = _cl.build_counts().get(_cl.key_tag(key), 1)
+        self._journal(
+            {
+                "event": "compile_build",
+                "key": list(key),
+                "key_tag": _cl.key_tag(key),
+                "wall_s": round(time.perf_counter() - t_build, 4),
+                "builds": builds,
+                "recompile": builds > 1,
+            }
+        )
         rcfg = self.cfg.resilience
         runner = ResilientRunner.from_config(
             ens,
@@ -662,6 +706,15 @@ class SimServer:
         return self._root_plan(peek)
 
     def _run_campaign(self, key: tuple) -> None:
+        # time-to-first-chunk clock starts at campaign open (model build
+        # included — at production request rates compile time IS the p99)
+        self._campaign_open = time.monotonic()
+        self._first_chunk_done = False
+        self._bucket_tag = _cl.key_tag(key)
+        # discard request-trace events a PREVIOUS campaign failed to flush
+        # (an exception skipped its campaign-close gather): carrying them
+        # forward would misattribute that work to THIS campaign's file
+        _rt.LOG.drain()
         ck_k = self._peek_checkpoint_members(self._campaign_dir(key))
         runner, ens = self._build_runner(key, k=ck_k)
         self._runner = runner
@@ -702,6 +755,25 @@ class SimServer:
             self._global_step = runner.step
             self._runner = None
             self._slots_state = (0, int(self.cfg.slots))
+            # host-local teardown only on this path (no collectives on a
+            # possibly-exceptional exit): unbind the active trace ids and
+            # zero the fleet + this bucket's MFU gauges between campaigns
+            # (a labeled gauge left at its last in-flight value would read
+            # as phantom utilization on every later scrape)
+            _rt.clear_active()
+            _tm.gauge(
+                "serve_mfu",
+                "model-flops utilization per compat bucket",
+                bucket=self._bucket_tag,
+            ).set(0.0)
+            _tm.gauge(
+                "serve_fleet_utilization",
+                "running-slot fraction of the fleet (0 between campaigns)",
+            ).set(0.0)
+            _tm.gauge(
+                "serve_fleet_devices_busy",
+                "devices executing campaign work right now",
+            ).set(0)
         self._sync("serve-campaign-close")
 
     def _try_resume(self, runner) -> None:
@@ -801,6 +873,7 @@ class SimServer:
                 {
                     "event": "request_scheduled",
                     "id": req.id,
+                    "trace_id": req.trace_id,
                     "slot": i,
                     "target": slots[i].target,
                     "restored": True,
@@ -902,6 +975,7 @@ class SimServer:
                     {
                         "event": "request_requeued",
                         "id": req.id,
+                        "trace_id": req.trace_id,
                         "slot": entry["old"],
                         "progress": entry["base"],
                         "target": entry["target"],
@@ -944,9 +1018,31 @@ class SimServer:
         under-report lanes the refill is about to reclaim."""
         running = sum(1 for s in slots if s.running)
         self._slots_state = (running, total)
+        util = (running / total) if total else 0.0
         _tm.gauge(
             "serve_slot_utilization", "running slots / campaign slot count"
-        ).set((running / total) if total else 0.0)
+        ).set(util)
+        # fleet-level view (the mesh-sharded-serve item's gate gauges):
+        # today one campaign spans every device, so busy-devices is all-or-
+        # nothing; sub-mesh campaigns will report their own share here
+        _tm.gauge(
+            "serve_fleet_utilization",
+            "running-slot fraction of the fleet (0 between campaigns)",
+        ).set(util)
+        try:
+            import jax
+
+            # LOCAL devices: gauges stay per-host in the fleet snapshot
+            # (gather labels them host=<i>), so per-host values must sum
+            # to the global count — the global count here would overcount
+            # the fleet by nproc on any sum-over-hosts panel
+            devices = int(jax.local_device_count())
+        except Exception:
+            devices = 1
+        _tm.gauge(
+            "serve_fleet_devices_busy",
+            "devices executing campaign work right now",
+        ).set(devices if running else 0)
 
     def _fill_slots(self, runner, ens, slots: list[_Slot], key: tuple) -> None:
         """Refill every idle lane from this bucket's queue (fresh IC via
@@ -1046,6 +1142,7 @@ class SimServer:
                 {
                     "event": "request_scheduled",
                     "id": req.id,
+                    "trace_id": req.trace_id,
                     "slot": slot.index,
                     "target": slot.target,
                     "restored": False,
@@ -1059,7 +1156,10 @@ class SimServer:
         """Refresh the live queue/throughput gauges at one chunk boundary —
         host-side bookkeeping the scheduler already holds (slot occupancy
         is kept by :meth:`_refresh_slot_state` at claim/release time, so
-        the gauge and ``slot_info()`` can never disagree)."""
+        the gauge and ``slot_info()`` can never disagree).  MFU is labeled
+        PER BUCKET (``profiling.step_flops`` of this campaign's model ×
+        measured member rate), and the per-device memory watermarks refresh
+        here too (None-safe: CPU backends report nothing)."""
         _tm.gauge("serve_queue_depth", "requests waiting in queued/").set(
             self.queue.counts()["queued"]
         )
@@ -1075,9 +1175,12 @@ class SimServer:
                 from ..utils.profiling import PEAK_FLOPS, peak_flops_key
 
                 _tm.gauge(
-                    "serve_mfu", "model-flops utilization of the active campaign"
+                    "serve_mfu",
+                    "model-flops utilization per compat bucket",
+                    bucket=self._bucket_tag,
                 ).set(self._flops_member * rate / PEAK_FLOPS[peak_flops_key()])
         self._rate_mark = (now, self._member_steps)
+        _cl.update_device_memory_gauges()
 
     def _campaign_loop(self, runner, ens, slots: list[_Slot], key: tuple) -> None:
         root = self._is_root()
@@ -1101,9 +1204,38 @@ class SimServer:
                 )
             )
             before = runner.step
+            # bind the on-device trace ids for this dispatch: flight spans
+            # and incident dumps during the chunk are request-attributable
+            _rt.bind_slots(
+                {s.index: s.req.trace_id for s in running if s.req.trace_id}
+            )
+            t0_wall = time.time()
             with _tr.span("serve_chunk", steps=n, slots=len(running)):
                 runner.advance(n)
             advanced = runner.step - before
+            if self._first_chunk_done is False and advanced > 0:
+                self._first_chunk_done = True
+                self._journal(
+                    {
+                        **_cl.observe_first_chunk(
+                            key, time.monotonic() - self._campaign_open
+                        ),
+                        "key": list(key),
+                        "step": runner.step,
+                    }
+                )
+            if _rt.enabled() and advanced > 0:
+                dur = time.time() - t0_wall
+                for s in running:
+                    if s.req.trace_id:
+                        _rt.chunk_span(
+                            s.req.trace_id,
+                            t0_wall,
+                            dur,
+                            slot=s.index,
+                            steps=advanced,
+                            step=runner.step,
+                        )
             self._member_steps += advanced * len(running)
             if self.cfg.stability is not None and ens.pre_divergence_latched:
                 # the chunk rolled back in memory while every member is
@@ -1121,7 +1253,7 @@ class SimServer:
             # runner's interrupt flag via request_drain)
             if runner.on_boundary():
                 self._drain = True
-                self._drain_campaign(runner, ens, slots)
+                self._drain_campaign(runner, ens, slots, key)
                 return
             self._fill_slots(runner, ens, slots, key)
             self._refresh_slot_state(slots, ens.k)
@@ -1129,6 +1261,7 @@ class SimServer:
                 self._flush_results()
         if root:
             self._flush_results(force=True)
+        self._flush_reqtrace(runner, key)
         self._journal({"event": "campaign_end", "key": list(key),
                        "step": runner.step})
         # a cleanly finished campaign leaves no work to restore: settle the
@@ -1139,6 +1272,18 @@ class SimServer:
         if root:
             for path in checkpoint.checkpoint_files(runner.run_dir):
                 checkpoint.remove_checkpoint(path)
+
+    def _flush_reqtrace(self, runner, key: tuple) -> None:
+        """Gather every host's request-trace events for the closing
+        campaign and write one Perfetto file next to its checkpoints
+        (root-only write, allgather underneath — so the call sites are the
+        campaign-close and drain paths, where the fleet is aligned; the
+        env-pinned reqtrace flag makes the skip aligned too)."""
+        path = _rt.write_campaign_trace(runner.run_dir, self._bucket_tag)
+        if path is not None:
+            self._journal(
+                {"event": "campaign_trace", "key": list(key), "path": path}
+            )
 
     def _settle_boundary(self, runner, ens, slots: list[_Slot], key: tuple) -> None:
         """Process completions and deaths at a chunk boundary.  The
@@ -1288,6 +1433,7 @@ class SimServer:
                 {
                     "event": "bucket_dt_adjust",
                     "id": req.id,
+                    "trace_id": req.trace_id,
                     "slot": entry["slot"],
                     "prev_dt": float(req.dt),
                     "dt": plan["new_dt"],
@@ -1316,6 +1462,7 @@ class SimServer:
                 {
                     "event": "request_retry",
                     "id": req.id,
+                    "trace_id": req.trace_id,
                     "slot": slot.index,
                     "steps_done": steps_done,
                     "dt": retry.dt,
@@ -1337,6 +1484,7 @@ class SimServer:
                 {
                     "event": "request_failed",
                     "id": req.id,
+                    "trace_id": req.trace_id,
                     "slot": slot.index,
                     "reason": reason,
                     "dts": req.dts,
@@ -1383,6 +1531,17 @@ class SimServer:
                         ),
                     }
                 )
+                # the HA front-door gate metric: durable-queue enqueue to
+                # the FIRST streamed observable for this request (the
+                # result values just fetched are that first observable —
+                # later than finished_wall, which only marks the device
+                # reaching the step target)
+                first_obs_s = max(
+                    0.0, time.time() - (req.enqueued_s or req.submitted_s)
+                )
+                result["admission_to_first_observable_s"] = round(
+                    first_obs_s, 6
+                )
                 self.queue.complete(req, result)
                 self._completed += 1
                 _tm.counter(
@@ -1392,20 +1551,28 @@ class SimServer:
                     "serve_request_latency_seconds",
                     "submit-to-finish latency per completed request",
                 ).observe(result["latency_s"])
+                _tm.histogram(
+                    "serve_admission_to_first_observable_seconds",
+                    "durable enqueue to first streamed observable",
+                ).observe(first_obs_s)
                 self._journal(
                     {
                         "event": "request_done",
                         "id": req.id,
+                        "trace_id": req.trace_id,
                         "slot": i,
                         "steps": item["steps"],
                         names[0]: result[names[0]],
                         "latency_s": result["latency_s"],
+                        "first_observable_s": result[
+                            "admission_to_first_observable_s"
+                        ],
                         "step": item["step"],
                     }
                 )
         self._pending_results = keep
 
-    def _drain_campaign(self, runner, ens, slots: list[_Slot]) -> None:
+    def _drain_campaign(self, runner, ens, slots: list[_Slot], key: tuple = ()) -> None:
         """The graceful-drain path: flush resolved results, checkpoint the
         slot table + member states through the sharded two-phase writer
         (collective — every host is here together, the drain verdict was
@@ -1429,6 +1596,7 @@ class SimServer:
                 {
                     "event": "request_requeued",
                     "id": req.id,
+                    "trace_id": req.trace_id,
                     "slot": s.index,
                     "progress": req.progress,
                     "target": s.target,
@@ -1436,6 +1604,10 @@ class SimServer:
                 }
             )
         runner._drain_io()
+        # the drained campaign's request-trace events must land durably NOW
+        # (this incarnation is about to exit — the gather is collective and
+        # every host reaches this drain path together)
+        self._flush_reqtrace(runner, key)
         # the SIGTERM-drain incident ships with its timeline, like the
         # standalone runner's preempt path
         runner.incident_dump("drain")

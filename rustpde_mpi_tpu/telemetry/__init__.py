@@ -54,16 +54,23 @@ from .tracing import (  # noqa: F401
 )
 from .tracing import enabled as tracing_enabled  # noqa: F401
 from .tracing import set_enabled as set_tracing_enabled  # noqa: F401
+from . import compile_log  # noqa: F401
+from . import reqtrace  # noqa: F401
+from .reqtrace import assemble_request_trace  # noqa: F401
+from .reqtrace import mint as mint_trace_context  # noqa: F401
+from .reqtrace import enabled as reqtrace_enabled  # noqa: F401
+from .reqtrace import set_enabled as set_reqtrace_enabled  # noqa: F401
 
 
 def set_enabled(flag: bool) -> None:
-    """Master switch: metrics AND tracing together (the bench gate's OFF
-    leg; ``RUSTPDE_TELEMETRY=0`` / ``RUSTPDE_TRACE=0`` set the per-layer
-    defaults at import)."""
+    """Master switch: metrics AND tracing AND request tracing together
+    (the bench gate's OFF leg; ``RUSTPDE_TELEMETRY=0`` / ``RUSTPDE_TRACE=0``
+    / ``RUSTPDE_REQTRACE=0`` set the per-layer defaults at import)."""
     set_metrics_enabled(flag)
     set_tracing_enabled(flag)
+    set_reqtrace_enabled(flag)
 
 
 def enabled() -> bool:
-    """True when either layer records."""
-    return metrics_enabled() or tracing_enabled()
+    """True when any layer records."""
+    return metrics_enabled() or tracing_enabled() or reqtrace_enabled()

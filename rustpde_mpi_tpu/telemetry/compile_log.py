@@ -1,0 +1,268 @@
+"""Compile & device attribution: where the fleet's non-stepping time goes.
+
+The cold-start ROADMAP item needs numbers nobody records today: which
+compat key paid how much build/jit wall, how often a key RE-compiled
+(restart, elastic re-plan, dt re-bucket), and how long a request waits
+between campaign open and the first committed chunk.  This module is the
+recording half — the seams call in, the metrics registry carries the
+labeled series, the journal gets one row per observation:
+
+* :func:`observe_build` — wraps the model-build seam
+  (``workloads.registry.build_model_for_key``): per-compat-key build wall
+  time histogram + recompile counter (first build of a key in a process is
+  a compile, every later one a RE-compile),
+* :func:`observe_entry_compile` — wraps the jit-entry-point seam
+  (``models.campaign._compile_entry_points``): per-model-kind lowering/jit
+  wall, counted separately because dt-ladder re-jits re-enter it without a
+  model rebuild,
+* :func:`observe_first_chunk` — time-to-first-chunk per compat key (the
+  scheduler stamps campaign open and the first committed chunk),
+* :func:`update_device_memory_gauges` — live per-device memory watermarks
+  from ``jax.local_devices()[i].memory_stats()`` where the backend exposes
+  them (None-safe: CPU and the axon relay report nothing, the gauges just
+  stay unset),
+* :class:`ProfilerCapture` — the on-demand ``jax.profiler`` hook behind
+  ``POST /profile?seconds=N`` (capped by ``RUSTPDE_PROFILE_MAX_S``), also
+  fired as a ONE-SHOT when the ThroughputMonitor reports ``perf_degraded``
+  (observability closing the loop on robustness: the capture of the slow
+  window lands next to the journal row that flagged it).
+
+Everything here is host-side bookkeeping around seams that already exist;
+the bit-identical / ≤2% overhead telemetry contract is unchanged.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time as _time
+
+from .. import config as _config
+from . import metrics as _tm
+
+_builds: dict[str, int] = {}  # compat-key tag -> in-process build count
+_lock = threading.Lock()
+
+
+def key_tag(key) -> str:
+    """The short stable label for a compat key — the same sha1-12 tag the
+    scheduler's campaign directories use, so metrics, journal rows and
+    on-disk campaign state all name a bucket identically."""
+    import hashlib
+
+    return hashlib.sha1(repr(tuple(key)).encode()).hexdigest()[:12]
+
+
+def observe_build(key, wall_s: float, kind: str = "") -> dict:
+    """Record one model build for a compat key; returns the journal-ready
+    payload (the caller owns the journal, root-ness and all)."""
+    tag = key_tag(key)
+    with _lock:
+        _builds[tag] = _builds.get(tag, 0) + 1
+        count = _builds[tag]
+    _tm.histogram(
+        "compile_build_seconds",
+        "model build + jit wall per compat key",
+        key=tag,
+    ).observe(wall_s)
+    if count > 1:
+        _tm.counter(
+            "compile_recompiles_total",
+            "model rebuilds of an already-built compat key",
+            key=tag,
+        ).inc()
+    return {
+        "event": "compile_build",
+        "key_tag": tag,
+        "kind": kind,
+        "wall_s": round(wall_s, 4),
+        "builds": count,
+        "recompile": count > 1,
+    }
+
+
+def build_counts() -> dict:
+    """Per-key in-process build counts (tests + the bench payload)."""
+    with _lock:
+        return dict(_builds)
+
+
+def observe_entry_compile(model_kind: str, wall_s: float) -> None:
+    """One jit-entry-point compile (step/observables hoist+jit): re-entered
+    by dt-ladder re-jits without a model rebuild, so counted separately."""
+    _tm.histogram(
+        "model_entry_compile_seconds",
+        "entry-point hoist+jit wall per model kind",
+        model=model_kind,
+    ).observe(wall_s)
+    _tm.counter(
+        "model_entry_compiles_total",
+        "entry-point compile passes per model kind",
+        model=model_kind,
+    ).inc()
+
+
+def observe_first_chunk(key, wall_s: float) -> dict:
+    """Time-to-first-chunk: campaign open (model build start) to the first
+    committed chunk — the cold-start item's gate metric."""
+    tag = key_tag(key)
+    _tm.histogram(
+        "serve_time_to_first_chunk_seconds",
+        "campaign open to first committed chunk per compat key",
+        key=tag,
+    ).observe(wall_s)
+    return {
+        "event": "first_chunk",
+        "key_tag": tag,
+        "wall_s": round(wall_s, 4),
+    }
+
+
+# -- device memory watermarks --------------------------------------------------
+
+
+def update_device_memory_gauges() -> int:
+    """Refresh ``device_memory_bytes_in_use`` / ``device_memory_peak_bytes``
+    per local device from the backend's memory stats; returns how many
+    devices reported (0 on CPU / relay backends — None-safe by contract)."""
+    from ..utils.profiling import device_memory_stats
+
+    reported = 0
+    for dev, stats in device_memory_stats().items():
+        if not stats:
+            continue
+        reported += 1
+        if "bytes_in_use" in stats:
+            _tm.gauge(
+                "device_memory_bytes_in_use",
+                "live backend memory per device",
+                device=dev,
+            ).set(float(stats["bytes_in_use"]))
+        peak = stats.get("peak_bytes_in_use")
+        if peak is not None:
+            _tm.gauge(
+                "device_memory_peak_bytes",
+                "peak backend memory watermark per device",
+                device=dev,
+            ).set(float(peak))
+    return reported
+
+
+# -- on-demand / auto jax.profiler capture ------------------------------------
+
+
+class ProfilerCapture:
+    """Bounded, single-flight ``jax.profiler`` capture.
+
+    ``start(logdir, seconds)`` spawns a daemon thread that runs
+    ``start_trace``/``stop_trace`` around a sleep; a second start while one
+    is in flight is refused (409 shape at the HTTP layer).  Seconds are
+    capped by ``RUSTPDE_PROFILE_MAX_S`` — a typo'd ``?seconds=86400`` must
+    not pin the profiler for a day.  Injectable trace functions keep the
+    unit tests off the real profiler."""
+
+    def __init__(self, start_fn=None, stop_fn=None):
+        self._lock = threading.Lock()
+        self._busy = False
+        self._start_fn = start_fn
+        self._stop_fn = stop_fn
+        self.captures = 0
+        self.last: dict | None = None
+
+    @property
+    def busy(self) -> bool:
+        return self._busy
+
+    def max_seconds(self) -> float:
+        return float(_config.env_get("RUSTPDE_PROFILE_MAX_S", "60") or 60.0)
+
+    def start(self, logdir: str, seconds: float, reason: str = "manual") -> dict:
+        """Begin a capture; returns the status payload (``started`` False
+        carries the refusal reason)."""
+        try:
+            seconds = float(seconds)
+        except (TypeError, ValueError):
+            return {"started": False, "error": f"bad seconds {seconds!r}"}
+        if seconds <= 0:
+            return {"started": False, "error": "seconds must be positive"}
+        seconds = min(seconds, self.max_seconds())
+        with self._lock:
+            if self._busy:
+                return {"started": False, "error": "capture already running"}
+            self._busy = True
+        status = {
+            "started": True,
+            "dir": logdir,
+            "seconds": seconds,
+            "reason": reason,
+        }
+        self.last = status
+        thread = threading.Thread(
+            target=self._run,
+            args=(logdir, seconds, status),
+            name="profile-capture",
+            daemon=True,
+        )
+        thread.start()
+        return dict(status)
+
+    def _run(self, logdir: str, seconds: float, status: dict) -> None:
+        start = self._start_fn
+        stop = self._stop_fn
+        if start is None or stop is None:
+            import jax
+
+            start = start or jax.profiler.start_trace
+            stop = stop or jax.profiler.stop_trace
+        try:
+            os.makedirs(logdir, exist_ok=True)
+            start(logdir)
+            try:
+                _time.sleep(seconds)
+            finally:
+                stop()
+            status["done"] = True
+            self.captures += 1
+            _tm.counter(
+                "profiler_captures_total", "completed jax.profiler captures"
+            ).inc()
+        except Exception as exc:  # backend may refuse: recorded, never raised
+            status["done"] = False
+            status["error"] = f"{type(exc).__name__}: {exc}"
+        finally:
+            with self._lock:
+                self._busy = False
+
+
+#: process-wide capture the HTTP front and the perf_degraded hook share
+CAPTURE = ProfilerCapture()
+
+_degrade_fired = False
+
+
+def capture_on_perf_degraded(run_dir: str) -> dict | None:
+    """ONE-SHOT automatic capture when the SLO monitor reports a
+    ``perf_degraded`` regression: the first event per process captures a
+    short window into ``<run_dir>/profiles/degraded``; later events only
+    count.  Returns the status payload on the firing call, else None."""
+    global _degrade_fired
+    if _degrade_fired or not _tm.enabled():
+        return None
+    try:
+        import jax
+
+        host = int(jax.process_index())
+    except Exception:
+        host = 0
+    # per-host capture dir: the run_dir is shared across a multihost fleet
+    logdir = os.path.join(run_dir, "profiles", f"degraded_h{host}")
+    status = CAPTURE.start(
+        logdir, min(2.0, CAPTURE.max_seconds()), reason="perf_degraded"
+    )
+    # the one-shot is spent only by a capture that actually STARTED — a
+    # refusal (manual capture in flight) must leave the shot for the next
+    # perf_degraded event, or the auto-profile is silently lost forever
+    if status.get("started"):
+        _degrade_fired = True
+        return status
+    return None

@@ -78,6 +78,23 @@ def prometheus_text(registry=None) -> str:
     return "\n".join(lines) + ("\n" if lines else "")
 
 
+def _host_suffixed(path: str) -> str:
+    """Non-root processes of a multihost run get a ``.p<rank>`` suffix
+    before the extension (``metrics.jsonl`` -> ``metrics.p1.jsonl``): the
+    run_dir is SHARED across hosts, so every host appending the same path
+    would interleave torn lines into one file.  Root and single-process
+    runs keep the plain name — every existing reader is unchanged."""
+    try:
+        import jax
+
+        if jax.process_count() > 1 and jax.process_index() != 0:
+            root, ext = os.path.splitext(path)
+            return f"{root}.p{jax.process_index()}{ext}"
+    except Exception:
+        pass
+    return path
+
+
 class MetricsDumper:
     """Cadenced ``metrics.jsonl`` writer for headless runs.
 
@@ -98,7 +115,7 @@ class MetricsDumper:
         if every_s is None:
             env = _config.env_get("RUSTPDE_METRICS_DUMP_S", "")
             every_s = float(env) if env else 60.0
-        self.path = path
+        self.path = _host_suffixed(path)
         self.every_s = float(every_s)
         self.registry = registry if registry is not None else _metrics.default_registry()
         self._t0 = _time.monotonic()

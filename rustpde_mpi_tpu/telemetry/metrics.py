@@ -448,10 +448,10 @@ def merge_snapshots(snaps: list[dict]) -> dict:
 
 def gather_global_snapshot(registry: MetricsRegistry | None = None) -> dict:
     """Root-aggregated fleet snapshot: each host JSON-encodes its local
-    registry snapshot, the byte blobs ride the existing
-    ``multihost.allgather_host`` (length exchange first — allgather needs
-    equal shapes), and every host merges the stack identically.  On a
-    single process this is exactly the local snapshot."""
+    registry snapshot, the blobs ride ``multihost.allgather_bytes`` (the
+    shared variable-length-blob primitive the request-trace gather uses
+    too), and every host merges the stack identically.  On a single
+    process this is exactly the local snapshot."""
     import json
 
     reg = registry if registry is not None else REGISTRY
@@ -464,20 +464,10 @@ def gather_global_snapshot(registry: MetricsRegistry | None = None) -> dict:
         multi = False
     if not multi:
         return local
-    import numpy as np
-
     from ..parallel import multihost
 
-    blob = np.frombuffer(json.dumps(local).encode("utf-8"), np.uint8)
-    lengths = multihost.allgather_host(np.int64(blob.size))
-    width = int(lengths.max())
-    padded = np.zeros(width, np.uint8)
-    padded[: blob.size] = blob
-    stack = multihost.allgather_host(padded)
-    snaps = [
-        json.loads(bytes(stack[i, : int(lengths[i])]).decode("utf-8"))
-        for i in range(stack.shape[0])
-    ]
+    blobs = multihost.allgather_bytes(json.dumps(local).encode("utf-8"))
+    snaps = [json.loads(blob.decode("utf-8")) for blob in blobs]
     return merge_snapshots(snaps)
 
 

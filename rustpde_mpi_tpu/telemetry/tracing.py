@@ -68,6 +68,15 @@ class FlightRecorder:
         self._tids: dict[int, int] = {}
         self._pid = os.getpid()
         self.dumped = 0  # dump() calls (tests/ops counters)
+        self._dump_seq = 0  # monotonic dump ids (alloc_seq, lock-held)
+
+    def alloc_seq(self) -> int:
+        """Allocate the next dump sequence number (lock-held: concurrent
+        incident dumps — watchdog thread vs signal/atexit path — must not
+        collide on one seq and overwrite each other's file)."""
+        with self._lock:
+            self._dump_seq += 1
+            return self._dump_seq
 
     def _tid(self) -> int:
         ident = threading.get_ident()
@@ -143,6 +152,19 @@ class FlightRecorder:
 #: process-wide recorder every span records into
 RECORDER = FlightRecorder()
 
+#: optional span-args annotator (telemetry/reqtrace.py installs one while
+#: requests are bound to device slots): called once per completed span,
+#: its dict — the active request trace ids — is merged into the span args,
+#: so flight-recorder timelines and incident dumps are request-attributable
+_ANNOTATOR = None
+
+
+def set_span_annotator(fn) -> None:
+    """Install/clear the span annotator (``fn() -> dict | None``); one
+    global so the disabled path stays a single branch."""
+    global _ANNOTATOR
+    _ANNOTATOR = fn
+
 
 class _Span:
     __slots__ = ("name", "args", "_t0")
@@ -160,6 +182,11 @@ class _Span:
         if exc_type is not None:
             args = dict(args or {})
             args["error"] = exc_type.__name__
+        if _ANNOTATOR is not None:
+            extra = _ANNOTATOR()
+            if extra:
+                args = dict(args or {})
+                args.update(extra)
         RECORDER.add_complete(self.name, self._t0, RECORDER.now_us() - self._t0, args)
         return False
 
@@ -195,15 +222,33 @@ def dump_flight_record(
     run_dir: str, reason: str, step: int | None = None, extra: dict | None = None
 ) -> str | None:
     """Dump the flight recorder into ``run_dir`` as
-    ``flight_<reason>[_stepN].json``; best-effort (an incident dump must
-    never mask the incident), returns the path or None."""
+    ``flight_<reason>[_stepN]_nSEQ.json``; best-effort (an incident dump
+    must never mask the incident), returns the path or None.
+
+    ``SEQ`` is a process-monotonic dump sequence number and the payload
+    carries the active request trace ids (telemetry/reqtrace.py), so a
+    chaos soak's pile of dumps sorts chronologically and each one names
+    the requests that were on the device — attributable, not anonymous."""
     if not _ENABLED:
         return None
+    from . import reqtrace as _reqtrace
+
+    seq = RECORDER.alloc_seq()
+    trace_ids = _reqtrace.active_ids()
     tag = reason.replace(" ", "_").replace("/", "_")
-    name = f"flight_{tag}" + (f"_step{step}" if step is not None else "") + ".json"
+    name = (
+        f"flight_{tag}"
+        + (f"_step{step}" if step is not None else "")
+        + f"_n{seq:04d}.json"
+    )
     path = os.path.join(run_dir, name)
     try:
-        info = {"step": step, **(extra or {})} if step is not None or extra else extra
+        info = dict(extra or {})
+        if step is not None:
+            info["step"] = step
+        info["seq"] = seq
+        if trace_ids:
+            info["trace_ids"] = trace_ids
         return RECORDER.dump(path, reason=reason, extra=info)
     except OSError:
         return None

@@ -25,6 +25,7 @@ import json
 import os
 import sys
 import threading
+import time
 
 
 class JournalError(RuntimeError):
@@ -56,6 +57,13 @@ class JournalWriter:
         self._lock = threading.Lock()
 
     def append(self, record: dict) -> None:
+        # every row carries an ABSOLUTE unix stamp next to whatever
+        # run-relative clock the caller adds: per-incarnation wall_s values
+        # cannot be compared across restarts, but request-trace assembly
+        # (telemetry/reqtrace.py) must order one request's rows across any
+        # number of incarnations on one timeline
+        if "t" not in record:
+            record = {"t": round(time.time(), 6), **record}
         try:
             with self._lock:
                 if self._fh is None:

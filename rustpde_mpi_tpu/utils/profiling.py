@@ -157,6 +157,32 @@ def trace(logdir: str = "/tmp/jax-trace"):
                 print(f"profiler stop failed: {exc}")
 
 
+def device_memory_stats() -> dict:
+    """Per-local-device backend memory stats, None-safe by contract:
+    ``{device_label: stats_dict_or_None}`` where ``stats_dict`` is whatever
+    ``Device.memory_stats()`` reports (``bytes_in_use`` /
+    ``peak_bytes_in_use`` on TPU/GPU) and None where the backend exposes
+    nothing (CPU, the axon relay) — callers must treat a missing dict as
+    "no data", never as zero.  The telemetry layer's device-memory
+    watermark gauges (telemetry/compile_log.py) read through this one
+    helper so the None-handling lives in one place."""
+    out = {}
+    try:
+        import jax
+
+        devices = jax.local_devices()
+    except Exception:
+        return out
+    for dev in devices:
+        label = f"{dev.platform}:{dev.id}"
+        try:
+            stats = dev.memory_stats()
+        except Exception:
+            stats = None
+        out[label] = dict(stats) if stats else None
+    return out
+
+
 # ---------------------------------------------------------------------------
 # FLOPs / MFU
 # ---------------------------------------------------------------------------

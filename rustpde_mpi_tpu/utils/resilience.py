@@ -1156,6 +1156,15 @@ class ResilientRunner:
             ).inc()
             _tr.instant("perf_degraded", **degraded)
             self._journal({"event": "perf_degraded", **degraded})
+            # observability closing the loop on robustness: the FIRST
+            # perf_degraded per process triggers a one-shot jax.profiler
+            # capture of the slow window (telemetry/compile_log.py) — the
+            # profile of the regression lands next to the row flagging it
+            from ..telemetry import compile_log as _cl
+
+            capture = _cl.capture_on_perf_degraded(self.run_dir)
+            if capture is not None:
+                self._journal({"event": "profile_capture", **capture})
         if self._metrics_dumper is not None:
             self._metrics_dumper.maybe_dump(step=self.step)
         if self._preempt_agreed():
@@ -1327,8 +1336,24 @@ class ResilientRunner:
         try:
             path = _tr.dump_flight_record(self.run_dir, reason, step=self.step)
             if path is not None:
+                # the dump's sequence number + the trace ids of the
+                # requests that were on the device: a chaos soak's dump
+                # pile stays attributable and chronologically sortable.
+                # The seq comes from THIS dump's filename — a counter read
+                # here could name a concurrent dump's id instead
+                import re as _re
+
+                from ..telemetry import reqtrace as _reqtrace
+
+                m = _re.search(r"_n(\d+)\.json$", path)
                 self._journal(
-                    {"event": "flight_record", "reason": reason, "path": path}
+                    {
+                        "event": "flight_record",
+                        "reason": reason,
+                        "path": path,
+                        "seq": int(m.group(1)) if m else None,
+                        "trace_ids": _reqtrace.active_ids() or None,
+                    }
                 )
         except Exception:
             pass

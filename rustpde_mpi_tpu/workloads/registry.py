@@ -66,7 +66,17 @@ def build_model(
 def build_model_for_key(key: tuple, *, mesh=None):
     """Build the campaign model one compat-key bucket needs (the serve
     scheduler's campaign constructor): ``key`` is the 10-tuple
-    ``(kind, nx, ny, ra, pr, dt, aspect, bc, periodic, scenario_sig)``."""
+    ``(kind, nx, ny, ra, pr, dt, aspect, bc, periodic, scenario_sig)``.
+
+    This is THE model-build/jit seam for every bucket, so compile
+    attribution hangs here: build wall time and the recompile count are
+    recorded per compat key (telemetry/compile_log.py) — the cold-start
+    ROADMAP item's baseline numbers."""
+    import time as _time
+
+    from ..telemetry import compile_log
+
+    t0 = _time.perf_counter()
     kind, nx, ny, ra, pr, dt, aspect, bc, periodic, scenario_sig = key
     scenario = dict(scenario_sig) if scenario_sig else None
     if scenario and "passive_scalar" in scenario:
@@ -88,6 +98,7 @@ def build_model_for_key(key: tuple, *, mesh=None):
             f"registry builder for {kind!r} produced compat_key "
             f"{model.compat_key} for requested key {tuple(key)}"
         )
+    compile_log.observe_build(key, _time.perf_counter() - t0, kind=str(kind))
     return model
 
 

@@ -124,6 +124,10 @@ def main() -> int:
         # count for both lint layers + baseline size, with a delta gate —
         # NEW findings (or stale baseline entries) fail the record run
         "lint": _lint_payload(),
+        # perf-trend payload (TREND.json written alongside): per-config
+        # BENCH_r*/BENCH_FULL trajectories with a noise-band regression
+        # gate — an un-acked regression fails the record run via rc=5
+        "trend": _trend_payload(),
         "date": _utc_now(),
     }
     _persist(record, tier_key)
@@ -139,6 +143,15 @@ def main() -> int:
             "run scripts/lint.py\n"
         )
         return 4
+    trend = record["trend"] or {}
+    if trend.get("clean") is False:
+        sys.stderr.write(
+            "perf-trend gate: un-acked regressions "
+            f"{trend.get('regressions_unacked')} — investigate, or ack with "
+            "a written reason: scripts/bench_trend.py --ack <config> "
+            "--reason '...'\n"
+        )
+        return 5
     # the budget gate applies to the FAST (= tier-1) selection only: slow-
     # tier tests (multiprocess spawns, soaks) legitimately run for minutes
     if args.fast and record["over_budget"]:
@@ -452,6 +465,38 @@ def _lint_payload() -> dict | None:
         json.dump(payload, f, indent=1)
     os.replace(tmp, path)
     return payload
+
+
+def _trend_payload() -> dict | None:
+    """Run ``scripts/bench_trend.py --json --gate`` (writes TREND.json at
+    the repo root, like LINT.json) and return the compact verdict.
+    ``clean`` False — an un-acked perf regression against the rolling best
+    — fails the record run via rc=5.  Best-effort on infrastructure
+    errors: the error string is recorded instead."""
+    try:
+        proc = subprocess.run(
+            [
+                sys.executable,
+                os.path.join(_REPO, "scripts", "bench_trend.py"),
+                "--json",
+                "--gate",
+            ],
+            capture_output=True,
+            text=True,
+            timeout=120,
+            cwd=_REPO,
+        )
+        data = json.loads(proc.stdout)
+    except Exception as exc:  # noqa: BLE001 — recording must not fail the run
+        return {"error": f"{type(exc).__name__}: {exc}"}
+    return {
+        "band": data.get("band"),
+        "configs": len(data.get("configs", {})),
+        "regressions": data.get("regressions", []),
+        "regressions_unacked": data.get("regressions_unacked", []),
+        "clean": proc.returncode == 0,
+        "date": _utc_now(),
+    }
 
 
 def _utc_now() -> str:

@@ -278,6 +278,45 @@ def mode_serve_campaign(out_dir):
     summary = srv.serve()
     from rustpde_mpi_tpu.parallel import sanitizer
 
+    # MetricsDumper multihost-collision regression (ISSUE 13 satellite):
+    # every rank constructs a dumper over the SAME logical path in the
+    # shared out_dir — non-root ranks must land on a .p<rank>-suffixed
+    # file instead of interleaving torn lines into root's
+    from rustpde_mpi_tpu.telemetry.exporters import MetricsDumper
+
+    shared = os.path.join(out_dir, "mp_metrics.jsonl")
+    dumper = MetricsDumper(shared)
+    dumper.dump(step=0)
+    if multihost.is_root():
+        expected = shared
+    else:
+        expected = os.path.join(
+            out_dir, f"mp_metrics.p{jax.process_index()}.jsonl"
+        )
+    assert dumper.path == expected, (dumper.path, expected)
+    assert os.path.exists(expected), expected
+    multihost.sync_hosts("metrics-suffix-dumped")
+
+    # root-side trace assembly (ISSUE 13 tentpole): when any chunk ran,
+    # the campaign-close gather must have written Perfetto trace files on
+    # root with events from EVERY host
+    import glob as _glob
+
+    trace_files = sorted(
+        _glob.glob(os.path.join(run_dir, "campaigns", "*", "trace_*.json"))
+    )
+    trace_hosts = 0
+    for tf in trace_files:
+        with open(tf) as fh:
+            payload = json.load(fh)
+        pids = {e.get("pid") for e in payload.get("traceEvents", [])}
+        trace_hosts = max(trace_hosts, len(pids))
+    if multihost.is_root() and summary["member_steps"] > 0:
+        assert trace_files, "no campaign trace assembled on root"
+        assert trace_hosts == jax.process_count(), (
+            trace_hosts,
+            jax.process_count(),
+        )
     if multihost.is_root():
         events = [
             e.get("event")
@@ -296,6 +335,8 @@ def mode_serve_campaign(out_dir):
                     # collective-sequence sanitizer counters (armed via
                     # RUSTPDE_SANITIZE in the chaos soak / bench mp leg)
                     "sanitizer": sanitizer.stats(),
+                    "trace_files": len(trace_files),
+                    "trace_hosts": trace_hosts,
                     "queue": srv.queue.counts(),
                     "slots": slots,
                     "nproc": jax.process_count(),

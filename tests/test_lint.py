@@ -257,6 +257,75 @@ def f(state):
     )
 
 
+# -- RPD008: span tags around collective dispatches ---------------------------
+
+
+def test_rpd008_host_local_span_kwarg_flagged():
+    src = '''
+import time
+
+def loop(self, runner, n):
+    with span("serve_chunk", t=time.monotonic()):
+        runner.update_n(n)
+'''
+    found = lint_source(src, "rustpde_mpi_tpu/serve/scheduler.py")
+    assert "RPD008" in rules_of(found)
+    (f,) = [f for f in found if f.rule == "RPD008"]
+    assert "host-local" in f.message
+
+
+def test_rpd008_computed_span_name_flagged():
+    src = '''
+import os
+
+def loop(self, runner, n):
+    with span(f"chunk_{os.getpid()}"):
+        runner.update_n(n)
+'''
+    found = lint_source(src, "rustpde_mpi_tpu/serve/scheduler.py")
+    assert "RPD008" in rules_of(found)
+    assert any("LITERAL name" in f.message for f in found)
+
+
+def test_rpd008_shipped_shape_passes():
+    # the repo's own shape: literal name, args from a root-broadcast plan
+    src = '''
+def loop(self, runner, running):
+    n = broadcast_obj(self._plan())
+    with span("serve_chunk", steps=n, slots=len(running)):
+        runner.update_n(n)
+'''
+    assert "RPD008" not in rules_of(
+        lint_source(src, "rustpde_mpi_tpu/serve/scheduler.py")
+    )
+
+
+def test_rpd008_span_without_collective_body_not_flagged():
+    src = '''
+import time
+
+def log_it(self):
+    with span("host_only", t=time.monotonic()):
+        self.counter += 1
+'''
+    assert "RPD008" not in rules_of(
+        lint_source(src, "rustpde_mpi_tpu/serve/scheduler.py")
+    )
+
+
+def test_rpd008_out_of_scope_module_not_flagged():
+    src = '''
+import time
+
+def loop(self, runner, n):
+    with span("chunk", t=time.monotonic()):
+        runner.update_n(n)
+'''
+    assert "RPD008" not in rules_of(
+        lint_source(src, "rustpde_mpi_tpu/models/navier.py")
+    )
+
+
 # -- generic layer ------------------------------------------------------------
 
 

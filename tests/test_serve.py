@@ -1001,3 +1001,168 @@ def test_serve_chaos_soak(tmp_path):
         with open(os.path.join(done_dir, name)) as fh:
             res = json.load(fh)["result"]
         assert res["nu"] == pytest.approx(_solo_nu(res), rel=1e-9)
+
+
+# -- request tracing end-to-end (ISSUE 13 tentpole) ----------------------------
+
+
+def test_trace_context_survives_drain_restart_and_rebucket(tmp_path, monkeypatch):
+    """The acceptance gate: admission -> SIGTERM drain -> restart ->
+    re-claim -> proactive re-bucket at a lower dt rung -> done yields ONE
+    trace_id across both incarnations' journal rows, and the assembled
+    Perfetto timeline (the /requests/<id>/trace payload) reconstructs the
+    whole lifecycle on one ordered timeline."""
+    from rustpde_mpi_tpu.config import StabilityConfig
+
+    monkeypatch.setenv("RUSTPDE_SPIKE_FACTOR", "500")
+    mk = lambda fault: SimServer(
+        _cfg(tmp_path, slots=2, stability=StabilityConfig(ladder_ratio=4.0)),
+        fault=fault,
+    )
+    # incarnation 1: admitted, scheduled, SIGTERM-drained mid-campaign
+    srv = mk("kill@8")
+    req = srv.submit(dict(_REQ, seed=0, horizon=0.2))
+    rid, tid = req.id, req.trace_id
+    assert tid and len(tid) == 16
+    assert srv.serve()["outcome"] == "drained"
+    # incarnation 2: re-claims mid-trajectory, a velocity spike trips the
+    # CFL sentinel -> bucket_dt_adjust re-buckets at dt/4, completes
+    srv2 = mk("spike@14")
+    s2 = srv2.serve()
+    assert s2["outcome"] == "idle"
+    assert s2["completed"] == 1 and s2["failed"] == 0
+    assert s2["bucket_dt_adjusts"] >= 1
+
+    events = _events(str(tmp_path / "serve"))
+    mine = [e for e in events if e.get("id") == rid]
+    names = [e["event"] for e in mine]
+    for expected in (
+        "request_admitted",
+        "request_scheduled",
+        "request_requeued",  # the drain
+        "bucket_dt_adjust",  # the re-bucket
+        "request_done",
+    ):
+        assert expected in names, (expected, names)
+    # ONE trace id across every lifecycle row of both incarnations
+    tids = {e["trace_id"] for e in mine if e.get("trace_id")}
+    assert tids == {tid}
+    # the restart re-claimed the drained slot mid-trajectory
+    assert any(
+        e.get("restored") for e in mine if e["event"] == "request_scheduled"
+    )
+    # every row carries the absolute stamp assembly orders by
+    assert all(isinstance(e.get("t"), float) for e in mine)
+
+    # the assembled timeline: one trace, both incarnations, ordered
+    trace = srv2.request_trace(rid)
+    assert trace is not None
+    other = trace["otherData"]
+    assert other["trace_id"] == tid and other["request_id"] == rid
+    assert other["incarnations"] == 2
+    tnames = [e["name"] for e in trace["traceEvents"]]
+    assert "request_admitted" in tnames and "request_done" in tnames
+    assert "bucket_dt_adjust" in tnames
+    assert tnames.count("chunk") >= 2  # device work in BOTH incarnations
+    assert "queued" in tnames and "running" in tnames  # derived phases
+    assert all(
+        e["args"]["trace_id"] == tid for e in trace["traceEvents"]
+    )
+    ts = [e["ts"] for e in trace["traceEvents"]]
+    assert ts == sorted(ts) and ts[0] == 0.0
+    # the per-campaign Perfetto files the assembly read actually landed
+    # (root-side write at campaign close/drain) — across TWO buckets (the
+    # original dt and the re-bucketed rung)
+    import glob
+
+    tfiles = glob.glob(
+        os.path.join(str(tmp_path / "serve"), "campaigns", "*", "trace_*.json")
+    )
+    assert len(tfiles) >= 2
+    assert any(e["event"] == "campaign_trace" for e in events)
+    # flight dumps of the drain are sequenced and attributable
+    frs = [e for e in events if e.get("event") == "flight_record"]
+    assert frs and all("seq" in e for e in frs)
+
+
+def test_http_trace_and_profile_endpoints(tmp_path, monkeypatch):
+    """GET /requests/<id>/trace serves the assembled timeline, POST
+    /profile drives the bounded single-flight profiler capture, and the
+    202 admission ack carries the trace id clients correlate on."""
+    from rustpde_mpi_tpu.serve.http_front import HttpFront
+    from rustpde_mpi_tpu.telemetry import compile_log
+
+    srv = SimServer(_cfg(tmp_path, slots=2))
+    req = srv.submit(dict(_REQ, seed=0))
+    assert srv.serve()["completed"] == 1
+    # keep the profiler itself out of the test: injected no-op trace fns
+    monkeypatch.setattr(
+        compile_log,
+        "CAPTURE",
+        compile_log.ProfilerCapture(
+            start_fn=lambda d: None, stop_fn=lambda: None
+        ),
+    )
+    front = HttpFront(srv)
+    front.start()
+    try:
+        host, port = front.address
+        base = f"http://{host}:{port}"
+
+        def get(path):
+            try:
+                with urllib.request.urlopen(base + path, timeout=30) as resp:
+                    return resp.status, json.loads(resp.read())
+            except urllib.error.HTTPError as err:
+                return err.code, json.loads(err.read())
+
+        def post(path, payload=None):
+            data = json.dumps(payload or {}).encode()
+            r = urllib.request.Request(base + path, data=data, method="POST")
+            try:
+                with urllib.request.urlopen(r, timeout=30) as resp:
+                    return resp.status, json.loads(resp.read())
+            except urllib.error.HTTPError as err:
+                return err.code, json.loads(err.read())
+
+        code, trace = get(f"/requests/{req.id}/trace")
+        assert code == 200
+        assert trace["otherData"]["trace_id"] == req.trace_id
+        names = [e["name"] for e in trace["traceEvents"]]
+        assert "request_admitted" in names and "chunk" in names
+        assert get("/requests/unknown-id/trace")[0] == 404
+        # profile endpoint: bad args typed, good args 202, concurrent 409
+        assert post("/profile?seconds=nope")[0] == 400
+        assert post("/profile?seconds=-1")[0] == 400
+        code, status = post("/profile?seconds=2")
+        assert code == 202 and status["started"] is True
+        code, refusal = post("/profile?seconds=1")
+        assert code == 409 and "already running" in refusal["error"]
+        # the admission ack names the trace id
+        code, ack = post("/requests", dict(_REQ, seed=5))
+        assert code == 202 and len(ack["trace_id"]) == 16
+    finally:
+        front.stop()
+    # the capture was journaled (observability events ride the journal too)
+    events = [e["event"] for e in _events(srv.cfg.run_dir)]
+    assert "profile_capture" in events
+
+
+def test_compile_attribution_rides_serve_journal(tmp_path):
+    """Every campaign build journals a compile_build row (key-tagged, wall
+    time, recompile flag) and the first committed chunk a first_chunk row
+    — the cold-start item's baseline numbers, durably recorded."""
+    srv = SimServer(_cfg(tmp_path, slots=2))
+    srv.submit(dict(_REQ, seed=0))
+    srv.submit(dict(_REQ, dt=0.005, seed=1))  # second bucket: second build
+    assert srv.serve()["completed"] == 2
+    events = _events(srv.cfg.run_dir)
+    builds = [e for e in events if e["event"] == "compile_build"]
+    assert len(builds) == 2
+    assert all(e["wall_s"] > 0 and len(e["key_tag"]) == 12 for e in builds)
+    firsts = [e for e in events if e["event"] == "first_chunk"]
+    assert len(firsts) == 2
+    assert all(e["wall_s"] > 0 for e in firsts)
+    # the done records carry the HA gate metric
+    done = [e for e in events if e["event"] == "request_done"]
+    assert all(e["first_observable_s"] > 0 for e in done)

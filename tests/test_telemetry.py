@@ -373,3 +373,214 @@ def test_flight_record_dumped_on_dispatch_hang(tmp_path):
         f for f in os.listdir(tmp_path / "run") if f.startswith("flight_dispatch_hang")
     ]
     assert dumps, "no flight record dumped on DispatchHang"
+
+
+# -- request tracing (telemetry/reqtrace.py) -----------------------------------
+
+
+def test_reqtrace_mint_and_log_roundtrip(tmp_path):
+    from rustpde_mpi_tpu.telemetry import reqtrace
+
+    ctx = reqtrace.mint()
+    assert len(ctx["trace_id"]) == 16 and len(ctx["span"]) == 8
+    assert reqtrace.mint()["trace_id"] != ctx["trace_id"]
+
+    log = reqtrace.RequestTraceLog(capacity=64)
+    log.record(ctx["trace_id"], "chunk", 100.0, 0.5, {"steps": 4})
+    log.record(ctx["trace_id"], "marker", 101.0)
+    ev = log.events()
+    assert ev[0]["ph"] == "X" and ev[0]["dur"] == 0.5e6
+    assert ev[0]["args"] == {"trace_id": ctx["trace_id"], "steps": 4}
+    assert ev[1]["ph"] == "i"
+    # bounded: past capacity events are counted dropped, not grown
+    small = reqtrace.RequestTraceLog(capacity=64)
+    for i in range(200):
+        small.record("t", "spam", float(i))
+    assert len(small.events()) == 64 and small.dropped == 136
+    # drain empties
+    assert len(log.drain()) == 2 and log.events() == []
+
+
+def test_reqtrace_binding_annotates_spans_and_flight_dumps(tmp_path):
+    from rustpde_mpi_tpu.telemetry import reqtrace
+    from rustpde_mpi_tpu.telemetry import tracing as ttr
+
+    try:
+        reqtrace.bind_slots({0: "aaaa", 1: "bbbb", 2: "aaaa"})
+        assert reqtrace.active_ids() == ["aaaa", "bbbb"]
+        with telemetry.span("bound_span", step=1):
+            pass
+        ev = ttr.RECORDER.events()[-1]
+        assert ev["args"]["trace_ids"] == ["aaaa", "bbbb"]
+        assert ev["args"]["step"] == 1
+        # sequenced, attributed flight dumps: monotonic _nNNNN filenames,
+        # seq + trace_ids in the payload (the chaos-soak pile stays sorted
+        # and attributable)
+        p1 = telemetry.dump_flight_record(str(tmp_path), "probe")
+        p2 = telemetry.dump_flight_record(str(tmp_path), "probe")
+        assert p1 != p2 and os.path.exists(p1) and os.path.exists(p2)
+        d1 = json.load(open(p1))
+        d2 = json.load(open(p2))
+        assert d2["otherData"]["seq"] == d1["otherData"]["seq"] + 1
+        assert d1["otherData"]["trace_ids"] == ["aaaa", "bbbb"]
+        assert f"_n{d1['otherData']['seq']:04d}" in os.path.basename(p1)
+    finally:
+        reqtrace.clear_active()
+    # cleared: spans go back to unannotated
+    with telemetry.span("unbound_span"):
+        pass
+    assert "trace_ids" not in (ttr.RECORDER.events()[-1].get("args") or {})
+
+
+def test_reqtrace_campaign_write_and_assembly(tmp_path):
+    """Single-process end-to-end of the durable pieces: chunk events land
+    in a per-campaign Perfetto file; assembly reconstructs one timeline
+    from journal rows + the campaign file, keyed by the trace_id."""
+    from rustpde_mpi_tpu.telemetry import reqtrace
+    from rustpde_mpi_tpu.utils.journal import JournalWriter
+
+    run_dir = str(tmp_path / "serve")
+    cdir = os.path.join(run_dir, "campaigns", "deadbeef0000")
+    os.makedirs(cdir)
+    tid = "feedfacefeedface"
+    reqtrace.chunk_span(tid, 1000.0, 0.25, slot=0, steps=4)
+    path = reqtrace.write_campaign_trace(cdir, "deadbeef0000")
+    assert path and os.path.basename(path) == "trace_0000.json"
+    # a second campaign close APPENDS a new file (incarnations never clobber)
+    reqtrace.chunk_span(tid, 1001.0, 0.25, slot=0, steps=4)
+    path2 = reqtrace.write_campaign_trace(cdir, "deadbeef0000")
+    assert os.path.basename(path2) == "trace_0001.json"
+    # no events -> no file, no error
+    assert reqtrace.write_campaign_trace(cdir, "deadbeef0000") is None
+
+    w = JournalWriter(os.path.join(run_dir, "journal.jsonl"))
+    w.append({"event": "server_start"})
+    w.append({"event": "request_admitted", "id": "r1", "trace_id": tid})
+    w.append({"event": "request_scheduled", "id": "r1", "trace_id": tid})
+    w.append({"event": "request_done", "id": "r1", "trace_id": tid})
+    w.close()
+    trace = reqtrace.assemble_request_trace(run_dir, "r1")
+    assert trace["otherData"]["trace_id"] == tid
+    assert trace["otherData"]["incarnations"] == 1
+    names = [e["name"] for e in trace["traceEvents"]]
+    assert "request_admitted" in names and "chunk" in names
+    assert "queued" in names and "running" in names  # derived phases
+    assert all(e["args"]["trace_id"] == tid for e in trace["traceEvents"])
+    ts = [e["ts"] for e in trace["traceEvents"]]
+    assert ts == sorted(ts) and ts[0] == 0.0
+    # unknown request: None, not an empty payload
+    assert reqtrace.assemble_request_trace(run_dir, "nope") is None
+
+
+def test_journal_rows_carry_absolute_time(tmp_path):
+    from rustpde_mpi_tpu.utils.journal import JournalWriter, read_journal
+    import time as _t
+
+    path = str(tmp_path / "j.jsonl")
+    w = JournalWriter(path)
+    before = _t.time()
+    w.append({"event": "a"})
+    w.append({"event": "b", "t": 123.0})  # caller-provided stamps win
+    w.close()
+    rows = read_journal(path)
+    assert before - 1 <= rows[0]["t"] <= _t.time() + 1
+    assert rows[1]["t"] == 123.0
+
+
+# -- compile/device attribution (telemetry/compile_log.py) ---------------------
+
+
+def test_compile_log_build_attribution_and_recompile_count():
+    from rustpde_mpi_tpu.telemetry import compile_log
+
+    key = ("dns", 17, 17, 1e4, 1.0, 0.123456, 1.0, "rbc", False, ())
+    tag = compile_log.key_tag(key)
+    assert len(tag) == 12
+    first = compile_log.observe_build(key, 0.5, kind="dns")
+    assert first["recompile"] is False and first["builds"] >= 1
+    again = compile_log.observe_build(key, 0.25, kind="dns")
+    assert again["recompile"] is True and again["builds"] == first["builds"] + 1
+    snap = telemetry.snapshot()
+    series = {
+        tuple(sorted(s["labels"].items())): s
+        for s in snap["compile_build_seconds"]["series"]
+    }
+    assert (("key", tag),) in series
+    assert series[(("key", tag),)]["count"] >= 2
+    recomp = {
+        s["labels"]["key"]: s["value"]
+        for s in snap["compile_recompiles_total"]["series"]
+    }
+    assert recomp[tag] >= 1
+    assert compile_log.build_counts()[tag] >= 2
+    # time-to-first-chunk rides the same label
+    compile_log.observe_first_chunk(key, 1.5)
+    ttfc = telemetry.snapshot()["serve_time_to_first_chunk_seconds"]
+    assert any(s["labels"]["key"] == tag for s in ttfc["series"])
+
+
+def test_device_memory_gauges_none_safe():
+    """CPU backends report no memory stats: the helper returns the
+    None-marked dict and the gauge update counts zero devices instead of
+    inventing zeros."""
+    from rustpde_mpi_tpu.telemetry import compile_log
+    from rustpde_mpi_tpu.utils.profiling import device_memory_stats
+
+    stats = device_memory_stats()
+    assert stats  # at least one local device
+    reported = compile_log.update_device_memory_gauges()
+    with_stats = sum(1 for v in stats.values() if v)
+    assert reported == with_stats
+
+
+def test_profiler_capture_single_flight_and_bounds(tmp_path):
+    from rustpde_mpi_tpu.telemetry.compile_log import ProfilerCapture
+
+    started, stopped = [], []
+    cap = ProfilerCapture(
+        start_fn=lambda d: started.append(d), stop_fn=lambda: stopped.append(1)
+    )
+    assert cap.start(str(tmp_path), "nope")["started"] is False
+    assert cap.start(str(tmp_path), -1)["started"] is False
+    status = cap.start(str(tmp_path / "p"), 0.4, reason="test")
+    assert status["started"] is True and status["seconds"] == 0.4
+    # single-flight: a second start while the window runs is refused
+    refused = cap.start(str(tmp_path / "p2"), 0.1)
+    assert refused["started"] is False and "already running" in refused["error"]
+    for _ in range(200):
+        if not cap.busy:
+            break
+        import time as _t
+
+        _t.sleep(0.01)
+    assert not cap.busy and cap.captures == 1
+    assert started == [str(tmp_path / "p")] and stopped == [1]
+    assert cap.last.get("done") is True
+    # the cap clamps absurd windows
+    import rustpde_mpi_tpu.config  # noqa: F401 — registry import for env_get
+
+    assert cap.start(str(tmp_path / "p3"), 1e9)["seconds"] <= cap.max_seconds()
+
+
+def test_perf_degraded_auto_capture_one_shot(tmp_path, monkeypatch):
+    from rustpde_mpi_tpu.telemetry import compile_log
+
+    cap = compile_log.ProfilerCapture(
+        start_fn=lambda d: None, stop_fn=lambda: None
+    )
+    monkeypatch.setattr(compile_log, "CAPTURE", cap)
+    monkeypatch.setattr(compile_log, "_degrade_fired", False)
+    first = compile_log.capture_on_perf_degraded(str(tmp_path))
+    assert first is not None and first["reason"] == "perf_degraded"
+    # one-shot per process: a second regression only counts
+    assert compile_log.capture_on_perf_degraded(str(tmp_path)) is None
+
+
+def test_metrics_dumper_single_process_path_unchanged(tmp_path):
+    """The multihost collision fix suffixes NON-root ranks only; on a
+    single process (and on root) the path — and every existing reader —
+    is untouched.  The 2-proc suffix assertion lives in mp_worker's
+    serve_campaign mode."""
+    path = str(tmp_path / "metrics.jsonl")
+    d = MetricsDumper(path, every_s=1e9)
+    assert d.path == path

@@ -26,6 +26,12 @@ caught (README "Static analysis & sanitizer" has the rule -> bug table):
   ``config.env_knobs()`` so the README knob table stays complete.
 * RPD007 — cross-module private-attribute reach (PR 8 review: HTTP
   handlers reaching into ``sim._drain`` instead of a public surface).
+* RPD008 — a ``span(...)`` whose body dispatches collectives without a
+  host-aligned tag: the span NAME must be a string literal and its kwarg
+  values must not derive from host-local sources (clocks, env, rank
+  checks, randomness).  Instrumentation args that differ per host around
+  a collective are the desync-by-instrumentation shape the runtime
+  sanitizer can only catch once it has already happened.
 """
 
 from __future__ import annotations
@@ -574,6 +580,93 @@ def _module_level_env_reads(module) -> list:
     return out
 
 
+# -------------------------------------- RPD008 span tag around collectives
+
+
+def rule_span_collective_tag(module) -> list:
+    """RPD008: ``with span(...)`` bodies that dispatch collectives must
+    carry a host-aligned tag — literal name, no host-local kwarg values.
+
+    A span is pure host-side bookkeeping, BUT its argument expressions are
+    evaluated on every host: a name or kwarg computed from a clock, the
+    rank, the environment or randomness documents a DIFFERENT story per
+    host around the very dispatch that must stay in lockstep — and when
+    the recorded tags disagree, the flight recorders of a desynced fleet
+    cannot even be lined up to diagnose it.  The sanitizer catches the
+    desync at runtime; this catches the shape at review time."""
+    if not _in(module.relpath, MULTIHOST_MODULES):
+        return []
+    out = []
+    collective = COLLECTIVE_CALLS | DISPATCH_CALLS
+    for qualname, fn in _functions(module.tree):
+        # reuse RPD001's linear taint pass so sanctioned root-plan values
+        # (n = broadcast_obj(...)) stay clean span args
+        tainted: set = set()
+        cleared: set = set()
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 and isinstance(
+                node.targets[0], ast.Name
+            ):
+                name = node.targets[0].id
+                if _contains_call(node.value, SANCTIONED_CALLS):
+                    tainted.discard(name)
+                    cleared.add(name)
+                elif _is_host_local(node.value, tainted, cleared):
+                    tainted.add(name)
+                    cleared.discard(name)
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.With):
+                continue
+            spans = [
+                item.context_expr
+                for item in node.items
+                if isinstance(item.context_expr, ast.Call)
+                and _call_name(item.context_expr) == "span"
+            ]
+            if not spans:
+                continue
+            dispatches = any(
+                isinstance(n, ast.Call) and _call_name(n) in collective
+                for stmt in node.body
+                for n in ast.walk(stmt)
+            )
+            if not dispatches:
+                continue
+            for call in spans:
+                name_ok = (
+                    call.args
+                    and isinstance(call.args[0], ast.Constant)
+                    and isinstance(call.args[0].value, str)
+                )
+                if not name_ok:
+                    out.append(
+                        module.finding(
+                            "RPD008",
+                            call,
+                            "span wrapping a collective dispatch needs a "
+                            "LITERAL name — a computed tag can differ per "
+                            "host around the very call that must stay in "
+                            "lockstep",
+                            qualname,
+                        )
+                    )
+                for kw in call.keywords:
+                    if _is_host_local(kw.value, tainted, cleared):
+                        out.append(
+                            module.finding(
+                                "RPD008",
+                                kw.value,
+                                f"span kwarg '{kw.arg}' around a collective "
+                                "dispatch derives from a host-local source "
+                                "(clock/env/rank/random) — record a root-"
+                                "broadcast value or move the measurement "
+                                "outside the span",
+                                qualname,
+                            )
+                        )
+    return out
+
+
 # ------------------------------------------- RPD007 cross-module privates
 
 
@@ -650,4 +743,5 @@ RULES = (
     rule_asarray_on_sharded,
     rule_raw_env_read,
     rule_cross_module_private,
+    rule_span_collective_tag,
 )
