@@ -74,6 +74,7 @@ DEFAULT_CONFIGS = [
     "shardedio129",
     "serve129",
     "workloads129",
+    "stats129",
     "pallasconv",
     "periodic",
     "poisson1025",
@@ -102,6 +103,7 @@ METRIC_NAMES = {
     "shardedio129": "2D RBC sharded two-phase checkpoints, 2-proc CPU harness (sharded vs gathered write + elastic-restore gate)",
     "serve129": "2D RBC simulation service 129x129 Ra=1e7, 200 requests / 8 slots soak (drain+NaN chaos; member-steps/s + latency percentiles)",
     "workloads129": "multi-model workloads 129x129 (dns/lnse/adjoint member-steps/s per kind + solo-vs-ensemble parity + lnse onset-sign gate)",
+    "stats129": "2D RBC confined 129x129 Ra=1e7 in-scan physics stats (stats-on vs stats-off matched governed windows: bit-equal trajectory + <=5% overhead + budget-closure gates)",
     "pallasconv": "fused Pallas convection chain vs unfused dense (RUSTPDE_CONV_KERNEL A/B: ms/step + MFU + bit-tolerance deltas; 129x129 min, flagship rows on-chip)",
     "periodic": "2D RBC periodic 128x65 Ra=1e6",
     "periodic1024": "2D RBC periodic 1024x1025 Ra=1e9",
@@ -578,6 +580,136 @@ def bench_governor(nx, ny, ra, dt, steps):
             and san_ok
             and san_bit_equal
         ),
+    }
+
+
+def bench_stats(nx, ny, ra, dt, steps):
+    """In-scan physics-stats config (models/stats.py, ISSUE 14): stats-on
+    vs stats-off through the GOVERNED runner advance path (the production
+    shape: sentinels + stats share one scanned chunk), matched windows
+    interleaved rep by rep, min-of-reps — the same protocol as the PR-8
+    telemetry gate.
+
+    Gates (all fold into ``finite``):
+
+    * ``stats_bit_equal`` — both runners stepped the identical IC the
+      identical number of steps; the accumulators only READ the state, so
+      the committed trajectory must be EXACTLY equal (float equality),
+    * ``stats_overhead_ok`` — wall overhead ≤5% at the default stride
+      (the sample cost amortizes as ~1/stride),
+    * ``budget_ok`` — the engine's budget-closure readout is finite and
+      below threshold at 129².  The TIGHT gate is the kinetic-energy
+      residual (production − dissipation − dKE/dt): an instantaneous-rate
+      balance, so it must close even over this short spin-up window.  The
+      Nu-consistency residual (plate-flux vs the exact-relation flux
+      estimator) only converges in statistical stationarity — far beyond
+      a bench budget — so it gets a finite + transient-sanity bound; the
+      long-horizon campaigns the f64 ladder gates on are where it
+      tightens."""
+    import shutil
+    import tempfile
+
+    import jax as _jax
+    import numpy as np
+
+    from rustpde_mpi_tpu import Navier2D, ResilientRunner, config
+    from rustpde_mpi_tpu.config import StabilityConfig, StatsConfig
+
+    config.enable_compilation_cache()
+    ke_budget_gate = 0.05
+    nu_budget_gate = 3.0  # transient sanity bound (see docstring)
+
+    def build(stats=False):
+        model = Navier2D(nx, ny, ra, 1.0, dt, 1.0, "rbc", periodic=False)
+        model.set_velocity(0.1, 2.0, 2.0)
+        model.set_temperature(0.1, 2.0, 2.0)
+        model.write_intervall = 1e9
+        model.set_stability(StabilityConfig())
+        if stats:
+            model.set_stats(StatsConfig())
+        return model
+
+    L = max(16, int(steps))
+    window = 8 * L  # 8 sub-chunks per timed window (boundary cadence real)
+    reps = 7  # min-of-reps over interleaved windows: shared-box noise
+    dirs = [tempfile.mkdtemp(prefix="bench_stats_") for _ in range(2)]
+    try:
+        runners = {}
+        for key, d in (("on", dirs[0]), ("off", dirs[1])):
+            runners[key] = ResilientRunner(
+                build(stats=key == "on"),
+                max_time=float("inf"),
+                run_dir=d,
+                checkpoint_every_s=None,
+                max_chunk_steps=L,
+            )
+        walls = {"on": [], "off": []}
+        for key, r in runners.items():  # compile + warm the chunk shapes
+            r.advance(window)
+            _jax.block_until_ready(r.pde.state)
+        # the averaging window covers the TIMED windows only (the warmup
+        # chunk holds the wildest piece of the spin-up transient)
+        runners["on"].pde.reset_stats()
+        for _ in range(reps):
+            for key, r in runners.items():
+                t0 = time.perf_counter()
+                r.advance(window)
+                _jax.block_until_ready(r.pde.state)
+                walls[key].append(time.perf_counter() - t0)
+        overhead = min(walls["on"]) / min(walls["off"]) - 1.0
+        # exact float equality on the committed trajectory — the hard
+        # contract: stats-on stepping is bit-identical to stats-off
+        bit_equal = all(
+            bool(
+                np.array_equal(
+                    np.asarray(getattr(runners["on"].pde.state, name)),
+                    np.asarray(getattr(runners["off"].pde.state, name)),
+                )
+            )
+            for name in runners["off"].pde.state._fields
+        )
+        health = runners["on"].pde.stats_summary()
+    finally:
+        for d in dirs:
+            shutil.rmtree(d, ignore_errors=True)
+
+    overhead_ok = bool(overhead <= 0.05)
+    budget_ok = bool(
+        np.isfinite(health["nu_residual"])
+        and np.isfinite(health["ke_residual"])
+        and health["ke_residual"] < ke_budget_gate
+        and health["nu_residual"] < nu_budget_gate
+        and health["samples"] >= 2
+    )
+    steps_total = reps * window  # the timed reps only (warmup is untimed)
+    return {
+        "steps_per_sec": steps_total / sum(walls["on"]) if walls["on"] else 0.0,
+        "plain_steps_per_sec": (
+            steps_total / sum(walls["off"]) if walls["off"] else 0.0
+        ),
+        "stats_overhead_x": 1.0 + overhead,
+        "stats_overhead_ok": overhead_ok,
+        "stats_bit_equal": bit_equal,
+        "stats_stride": int(runners["on"].pde.stats_engine.stride),
+        "stats_samples": health["samples"],
+        "nu_plate_avg": health["nu_plate_avg"],
+        "nu_flux_avg": health["nu_flux_avg"],
+        "nu_residual": health["nu_residual"],
+        "ke_residual": health["ke_residual"],
+        "ke_budget_gate": ke_budget_gate,
+        "nu_budget_gate": nu_budget_gate,
+        "tail_max": max(
+            health[k]
+            for k in (
+                "tail_t_x", "tail_t_y", "tail_ux_x",
+                "tail_ux_y", "tail_uy_x", "tail_uy_y",
+            )
+        ),
+        "bl_thermal_pts": health["bl_thermal_pts"],
+        "bl_visc_pts": health["bl_visc_pts"],
+        "budget_ok": budget_ok,
+        "steps": window,
+        "finite": bool(bit_equal and overhead_ok and budget_ok),
     }
 
 
@@ -1680,6 +1812,10 @@ def main() -> int:
                 # fused-vs-dense convection A/B: parity + recompile gates
                 # everywhere, speed/MFU deltas honest only on-chip
                 r = bench_pallasconv(steps=max(8, min(steps, 16)))
+            elif name == "stats129":
+                # matched governed windows, stats-on vs stats-off; the
+                # window is capped so the doubled run fits the budget
+                r = bench_stats(129, 129, 1e7, 2e-3, max(32, min(steps, 64)))
             elif name == "governor129":
                 # overhead leg slope-times two chains; the spike legs rerun
                 # a capped horizon (governed: at the descended-ladder dt)

@@ -1,7 +1,17 @@
-"""Plot the running-average statistics file (data/statistics.h5).
+"""Plot running-average statistics files.
 
-Counterpart of the reference's plot/plot_statistics.py: mean temperature with
-mean-flow streamlines, and the pointwise Nusselt field.
+Counterpart of the reference's plot/plot_statistics.py — mean temperature
+with mean-flow streamlines, and the pointwise Nusselt field — reading BOTH
+layouts:
+
+* the legacy ``data/statistics.h5`` layout (models/statistics.py and the
+  stats engine's single-model export: root groups ``temp/ux/uy/nusselt``),
+* the stats engine's ensemble export (rustpde_mpi_tpu.export_stats:
+  per-member groups ``member{i}/...`` + a root ``members`` scalar) —
+  select the member with ``--member`` (default 0).
+
+Engine exports additionally carry ``profiles/`` (mean T, RMS profiles,
+convective flux) which ``--profiles`` renders as a third figure.
 """
 
 import argparse
@@ -14,22 +24,46 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 from plot_utils import plot_streamplot  # noqa: E402
 
 
+def stats_root(f, member: int):
+    """The group holding the ``temp/ux/uy/...`` layout: the file root for
+    legacy/single-model files, ``member<i>`` for ensemble engine exports."""
+    if "temp" in f:
+        return f
+    if "members" in f:
+        k = int(np.asarray(f["members"]))
+        if member >= k:
+            raise SystemExit(f"--member {member} out of range (file has {k})")
+        return f[f"member{member}"]
+    raise SystemExit(
+        "unrecognized statistics layout: neither a root 'temp' group "
+        "(legacy/single-model) nor a 'members' scalar (ensemble export)"
+    )
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--file", default="data/statistics.h5")
     ap.add_argument("--out", default="statistics.png")
+    ap.add_argument("--member", type=int, default=0,
+                    help="member group of an ensemble engine export")
+    ap.add_argument("--profiles", action="store_true",
+                    help="also plot the engine's profiles/ group")
     ap.add_argument("--show", action="store_true")
     args = ap.parse_args()
 
     import h5py
 
     with h5py.File(args.file, "r") as f:
-        t = np.asarray(f["temp/v"])
-        u = np.asarray(f["ux/v"])
-        v = np.asarray(f["uy/v"])
-        n = np.asarray(f["nusselt/v"])
-        x = np.asarray(f["temp/x"] if "temp/x" in f else f["x"])
-        y = np.asarray(f["temp/y"] if "temp/y" in f else f["y"])
+        g = stats_root(f, args.member)
+        t = np.asarray(g["temp/v"])
+        u = np.asarray(g["ux/v"])
+        v = np.asarray(g["uy/v"])
+        n = np.asarray(g["nusselt/v"])
+        x = np.asarray(g["temp/x"] if "temp/x" in g else g["x"])
+        y = np.asarray(g["temp/y"] if "temp/y" in g else g["y"])
+        profiles = None
+        if args.profiles and "profiles" in g:
+            profiles = {k: np.asarray(d) for k, d in g["profiles"].items()}
 
     import matplotlib
 
@@ -46,6 +80,23 @@ def main() -> int:
     out2 = args.out.replace(".png", "_nusselt.png")
     fig2.savefig(out2, bbox_inches="tight", dpi=200)
     print(f" ==> {out2}")
+    if profiles:
+        fig3, ax = plt.subplots(1, 2, figsize=(9, 4), sharey=True)
+        yy = profiles.get("y", y)
+        ax[0].plot(profiles["t_mean"], yy, label="<T>")
+        ax[0].plot(profiles["t_rms"], yy, label="T rms")
+        ax[0].set_xlabel("temperature")
+        ax[0].set_ylabel("y")
+        ax[0].legend()
+        ax[1].plot(profiles["ux_rms"], yy, label="ux rms")
+        ax[1].plot(profiles["uy_rms"], yy, label="uy rms")
+        ax[1].plot(profiles["flux"], yy, label="<uy T>")
+        ax[1].set_xlabel("velocity / flux")
+        ax[1].legend()
+        fig3.tight_layout()
+        out3 = args.out.replace(".png", "_profiles.png")
+        fig3.savefig(out3, bbox_inches="tight", dpi=200)
+        print(f" ==> {out3}")
     if args.show:
         plt.show()
     return 0

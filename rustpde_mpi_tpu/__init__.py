@@ -32,6 +32,7 @@ from .models.meanfield import MeanFields  # noqa: F401
 from .models.navier import Navier2D, NavierState  # noqa: F401
 from .models.opt_routines import steepest_descent_energy_constrained  # noqa: F401
 from .models.statistics import Statistics  # noqa: F401
+from .models.stats import StatsEngine, StatsState, export_stats  # noqa: F401
 from .models.steady_adjoint import Navier2DAdjoint  # noqa: F401
 from .models.swift_hohenberg import SwiftHohenberg1D, SwiftHohenberg2D  # noqa: F401
 from .utils.governor import (  # noqa: F401

@@ -116,6 +116,15 @@ register_knob("RUSTPDE_SPLIT_SEP_FALLBACK", "manual",
               "split-sep periodic under a mesh: manual shard_map | eager triage")
 register_knob("RUSTPDE_FORCE_FUSED_GSPMD", None,
               "1 = pin the known-miscompiling fused GSPMD split-sep path")
+# physics observability (models/stats.py in-scan statistics engine)
+register_knob("RUSTPDE_STATS", None,
+              "1 = arm the in-scan physics-stats engine on from_config DNS models")
+register_knob("RUSTPDE_STATS_STRIDE", "16",
+              "in-scan stats sampling stride (steps between samples)")
+register_knob("RUSTPDE_STATS_TAIL_WARN", "1e-3",
+              "spectral-tail energy fraction above which resolution_warning fires")
+register_knob("RUSTPDE_STATS_BUDGET_WARN", "0.5",
+              "Nu budget-closure residual above which budget_drift fires")
 # telemetry
 register_knob("RUSTPDE_TELEMETRY", "1", "telemetry master switch")
 register_knob("RUSTPDE_TRACE", "1", "flight-recorder span tracing switch")
@@ -319,6 +328,34 @@ class StabilityConfig:
 
 
 @dataclass
+class StatsConfig:
+    """Knobs for the in-scan physics-statistics engine
+    (:class:`~rustpde_mpi_tpu.models.stats.StatsEngine`, armed via a DNS
+    model's ``set_stats``): running spectral/profile/budget accumulators
+    updated ON DEVICE inside the scanned step chunk, vmapped per ensemble
+    member, carried through checkpoints bit-exactly.
+
+    * ``stride`` — steps between samples (None: ``RUSTPDE_STATS_STRIDE``,
+      default 16).  The sample cost is a handful of extra syntheses, so the
+      amortized overhead scales as ~1/stride (the bench gate holds it ≤5%),
+    * ``tail_warn`` — spectral-tail energy fraction (top third of the
+      ortho spectrum, per field/axis) above which the runner journals a
+      typed ``resolution_warning`` (None: ``RUSTPDE_STATS_TAIL_WARN``),
+    * ``budget_warn`` — Nu budget-closure residual (plate-flux Nu vs the
+      exact-relation ``1 + <uy*T> * 2*sy/ka``) above which the runner
+      journals a typed ``budget_drift`` (None:
+      ``RUSTPDE_STATS_BUDGET_WARN``).
+
+    The hard contract (CI- and bench-gated like the sentinel/telemetry
+    layers): the accumulators READ the state and never feed back — the
+    state trajectory is bit-identical stats-on vs stats-off."""
+
+    stride: int | None = None
+    tail_warn: float | None = None
+    budget_warn: float | None = None
+
+
+@dataclass
 class IOConfig:
     """Knobs for the overlapped I/O pipeline (utils/io_pipeline.py).
 
@@ -470,6 +507,14 @@ class ServeConfig:
     http_host: str = "127.0.0.1"
     http_port: int | None = None
     resilience: ResilienceConfig | None = None
+    # in-scan physics statistics (None = off): arms the stats engine on
+    # every DNS campaign ensemble — per-member running averages updated on
+    # device, reset when a lane is refilled by a new request, summarized
+    # into each done record ("stats": samples, Nu estimators, budget
+    # residuals, spectral-tail fractions).  Lane moves across a drain/
+    # re-plan restart the per-request averages (documented limitation);
+    # the bit-exact durability contract lives on the runner/campaign path.
+    stats: StatsConfig | None = None
     # governed campaign dt (None = reactive-only): arms the on-device
     # stability sentinels on every campaign ensemble and gives each bucket
     # a per-bucket DtLadder — a CFL-ceiling catch re-buckets the pinned
@@ -511,6 +556,10 @@ class NavierConfig:
     # stability-sentinel knobs (None = plain stepping; see StabilityConfig /
     # utils/governor.py) — from_config calls model.set_stability(stability)
     stability: StabilityConfig | None = None
+    # in-scan physics-statistics knobs (None = off unless RUSTPDE_STATS=1;
+    # see StatsConfig / models/stats.py) — from_config calls
+    # model.set_stats(stats)
+    stats: StatsConfig | None = None
     # scenario step modifiers (None = plain physics; a
     # workloads.modifiers.ScenarioConfig or equivalent dict: rotating-frame
     # coriolis rate, passive_scalar, scalar_kappa) — baked into the step

@@ -18,4 +18,10 @@ from .navier import (  # noqa: F401
 )
 from .opt_routines import steepest_descent_energy_constrained  # noqa: F401
 from .statistics import Statistics  # noqa: F401
+from .stats import (  # noqa: F401
+    HEALTH_NAMES,
+    StatsEngine,
+    StatsState,
+    export_stats,
+)
 from .steady_adjoint import AdjointState, Navier2DAdjoint  # noqa: F401

@@ -66,6 +66,8 @@ CAMPAIGN_MODEL_ATTRS = (
     "update_n_pending",
     "set_stability",
     "clear_pre_divergence",
+    "set_stats",
+    "stats_armed",
     "set_dt",
     "get_dt",
     "get_time",
@@ -109,6 +111,11 @@ class CampaignModelBase:
     # synchronous.
     io_pipeline = None
     io_overlap = False
+    # journal hook (utils/journal.JournalWriter): the resilient runner
+    # attaches its writer for the duration of a session so model-side
+    # statistics failures surface as typed journal events
+    # (models/stats.report_stats_event) instead of swallowed prints
+    journal_writer = None
 
     # -- construction-time bookkeeping ---------------------------------------
 
@@ -124,6 +131,13 @@ class CampaignModelBase:
         # rung at most once; recompile_count tracks actual rebuilds
         self._dt_cache: dict[float, dict] = {}
         self.recompile_count = 0
+        # in-scan physics-stats engine (models/stats.py): None = off;
+        # set_stats arms it — the running-sum pytree + its sample-cadence
+        # tick then ride the scanned chunks, the snapshot surface and the
+        # rollback snapshots exactly like the state itself
+        self._stats_engine = None
+        self.stats_state = None
+        self._stats_tick = None
 
     # -- physics hooks (per subclass) ----------------------------------------
 
@@ -248,6 +262,12 @@ class CampaignModelBase:
         self._sent_cc = None
         self._sent_consts = None
         self._step_n_sent = None
+        self._stats_cc = None
+        self._stats_consts = None
+        self._step_n_stats = None
+        self._stats_health_cc = None
+        self._stats_health_consts = None
+        self._stats_health_fn = None
         with self._scope():
             step_cc, step_consts = hoist_constants(self._make_step(), example)
             obs_cc, obs_consts = hoist_constants(self._make_observables(), example)
@@ -299,8 +319,64 @@ class CampaignModelBase:
         obs_jit = jax.jit(obs_cc)
         self._obs_fn = lambda s: obs_jit(self._obs_consts, s)
 
+        if self._stats_engine is not None:
+            self._compile_stats_entry_points(step_cc, example)
+
         if self._stability is not None:
             self._compile_sentinel_entry_points(example)
+
+    def _compile_stats_entry_points(self, step_cc, example) -> None:
+        """Stats-armed variant of the scanned chunk: the StatsState running
+        sums and a sample-cadence tick ride the carry next to the state.
+        The accumulator only READS the stepped state — it is a pure
+        consumer, so the state trajectory stays BIT-identical to the plain
+        chunk (the same contract the sentinel reductions ship under,
+        CI-asserted).  Accumulation is gated on the stride cond AND on the
+        step surviving ``_scan_ok`` (a corpse is never sampled)."""
+        import jax
+        import jax.numpy as jnp
+
+        from ..utils.jit import hoist_constants
+
+        eng = self._stats_engine
+        sx = eng.state_example()
+        with self._scope():
+            stats_cc, stats_consts = hoist_constants(eng.accum_fn(), sx, example)
+            health_cc, health_consts = hoist_constants(eng.health_fn(), sx)
+        self._stats_cc = stats_cc
+        self._stats_consts = stats_consts
+        self._stats_health_cc = health_cc
+        self._stats_health_consts = health_consts
+        health_jit = jax.jit(health_cc)
+        self._stats_health_fn = lambda ss: health_jit(health_consts, ss)
+        stride = int(eng.stride)
+
+        def step_n_stats(consts, sconsts, state, ss, tick, n: int):
+            def advance(carry):
+                st, ss, tk, ok, done = carry
+                st2 = step_cc(consts, st)
+                ok2 = self._scan_ok(st2)
+                tk2 = tk + 1
+                take = jnp.logical_and(ok2, (tk2[0] % stride) == 0)
+                ss2 = jax.lax.cond(
+                    take, lambda s: stats_cc(sconsts, s, st2), lambda s: s, ss
+                )
+                return st2, ss2, tk2, ok2, done + 1
+
+            def body(carry, _):
+                carry2 = jax.lax.cond(carry[3], advance, lambda c: c, carry)
+                return carry2, None
+
+            init = (state, ss, tick, jnp.asarray(True), jnp.asarray(0, jnp.int32))
+            (st, ss, tk, _, done), _ = jax.lax.scan(body, init, None, length=n)
+            return st, ss, tk, done
+
+        stats_jit = jax.jit(
+            step_n_stats, static_argnames=("n",), donate_argnums=(2, 3, 4)
+        )
+        self._step_n_stats = lambda s, ss, tk, n: stats_jit(
+            self._step_consts, self._stats_consts, s, ss, tk, n=n
+        )
 
     def _compile_eager_entry_points(self) -> None:
         """Per-stage eager fallback (the GSPMD split-sep miscompile guard):
@@ -344,17 +420,24 @@ class CampaignModelBase:
         self._sent_cc = sent_cc
         self._sent_consts = sent_consts
         ceiling = float(self._stability.max_cfl)
+        # with the stats engine armed, the running sums + sample tick ride
+        # the sentinel carry too (appended AFTER the sentinel slots, so the
+        # fetch indices the pending-resolve path reads stay put); sampling
+        # is gated on the step being finite AND under the ceiling — a
+        # tripping chunk's accumulation is discarded by the rollback anyway
+        stats_cc = self._stats_cc
+        stats_stride = int(self._stats_engine.stride) if stats_cc is not None else 0
 
-        def step_n_sent(consts, carry, n: int):
+        def step_n_sent(consts, sconsts, carry, n: int):
             def advance(carry):
-                st, fin, cok, done, cflm, gm, dvm, kep = carry
+                st, fin, cok, done, cflm, gm, dvm, kep = carry[:8]
                 st2, (cfl, ke, dv) = sent_cc(consts, st)
                 fin2 = self._scan_ok(st2)
                 # NaN cfl must read as the NaN path, not a ceiling trip:
                 # NaN > ceiling is False, so ~(cfl > ceiling) stays True
                 cok2 = jnp.logical_not(cfl > ceiling)
                 growth = jnp.where(kep > 0.0, ke / kep, 1.0)
-                return (
+                out = (
                     st2,
                     fin2,
                     cok2,
@@ -364,6 +447,18 @@ class CampaignModelBase:
                     jnp.maximum(dvm, dv),
                     ke,
                 )
+                if stats_cc is not None:
+                    ss, tk = carry[8], carry[9]
+                    tk2 = tk + 1
+                    take = fin2 & cok2 & ((tk2[0] % stats_stride) == 0)
+                    ss2 = jax.lax.cond(
+                        take,
+                        lambda s: stats_cc(sconsts, s, st2),
+                        lambda s: s,
+                        ss,
+                    )
+                    out = out + (ss2, tk2)
+                return out
 
             def body(carry, _):
                 carry2 = jax.lax.cond(
@@ -374,8 +469,10 @@ class CampaignModelBase:
             final, _ = jax.lax.scan(body, carry, None, length=n)
             return final
 
-        sent_jit = jax.jit(step_n_sent, static_argnames=("n",), donate_argnums=(1,))
-        self._step_n_sent = lambda c, n: sent_jit(self._sent_consts, c, n=n)
+        sent_jit = jax.jit(step_n_sent, static_argnames=("n",), donate_argnums=(2,))
+        self._step_n_sent = lambda c, n: sent_jit(
+            self._sent_consts, self._stats_consts, c, n=n
+        )
 
     # -- Integrate protocol ---------------------------------------------------
 
@@ -411,7 +508,19 @@ class CampaignModelBase:
             # so a state reference the caller retained stays readable, while
             # every inter-bucket hand-off inside the chain is donated
             state = jax.tree.map(jnp.copy, self.state)
-            self.state = run_scanned(lambda s, k: self._step_n(s, k)[0], state, n)
+            if self._step_n_stats is not None:
+                ss = jax.tree.map(jnp.copy, self.stats_state)
+                tick = jnp.copy(self._stats_tick)
+                st, ss, tick = run_scanned(
+                    lambda c, k: self._step_n_stats(c[0], c[1], c[2], k)[:3],
+                    (state, ss, tick),
+                    n,
+                )
+                self.state, self.stats_state, self._stats_tick = st, ss, tick
+            else:
+                self.state = run_scanned(
+                    lambda s, k: self._step_n(s, k)[0], state, n
+                )
         self.time += n * self.dt
         return None
 
@@ -444,6 +553,7 @@ class CampaignModelBase:
             )
         self._pre_div_latch = False
         rdt = config.real_dtype()
+        stats_on = self._stats_cc is not None
         with self._scope():
             state = jax.tree.map(jnp.copy, self.state)
             carry = (
@@ -456,10 +566,20 @@ class CampaignModelBase:
                 jnp.asarray(0.0, rdt),  # |div| max
                 jnp.asarray(0.0, rdt),  # previous-step ke
             )
+            if stats_on:
+                # the running sums + tick ride the sentinel carry (and the
+                # rollback snapshot below — a tripped chunk's samples are
+                # discarded with its steps)
+                carry = carry + (
+                    jax.tree.map(jnp.copy, self.stats_state),
+                    jnp.copy(self._stats_tick),
+                )
             carry = run_scanned(lambda c, k: self._step_n_sent(c, k), carry, n)
-        st, fin, cok, done, cflm, gm, dvm, ke = carry
-        snapshot = (self.state, self.time)
+        st, fin, cok, done, cflm, gm, dvm, ke = carry[:8]
+        snapshot = (self.state, self.time, self.stats_state, self._stats_tick)
         self.state = st  # provisional: resolve() confirms or restores
+        if stats_on:
+            self.stats_state, self._stats_tick = carry[8], carry[9]
         self.time += n * self.dt
         dt = self.dt
 
@@ -471,7 +591,9 @@ class CampaignModelBase:
                 # in-memory rollback: the dispatch stepped a donated COPY,
                 # so the snapshot still holds the chunk-start state — put it
                 # back and latch exit() until a governor acts
-                self.state, self.time = snapshot
+                (self.state, self.time, self.stats_state, self._stats_tick) = (
+                    snapshot
+                )
                 self._pre_div_latch = True
             status = ChunkStatus(
                 requested=int(n),
@@ -516,6 +638,116 @@ class CampaignModelBase:
         killed members and wants the chunk retried): unlatch ``exit()``."""
         self._pre_div_latch = False
 
+    # -- in-scan physics statistics (models/stats.py) --------------------------
+
+    def set_stats(self, cfg) -> None:
+        """Arm/disarm (``None``) the in-scan physics-stats engine
+        (:class:`~rustpde_mpi_tpu.config.StatsConfig`): compiles the
+        stats-carrying variants of the scanned chunks and zero-initializes
+        the running sums.  Under the GSPMD split-sep eager fallback the
+        in-scan engine is unavailable and stepping stays plain (a one-time
+        warning, like the sentinels)."""
+        import jax.numpy as jnp
+
+        if cfg is None:
+            self._stats_engine = None
+            self.stats_state = None
+            self._stats_tick = None
+            self._dt_cache.clear()
+            self._compile_entry_points()
+            return
+        from .stats import StatsEngine
+
+        self._stats_engine = StatsEngine(self, cfg)
+        self._dt_cache.clear()
+        self._compile_entry_points()
+        if self._stats_cc is None:
+            import warnings
+
+            warnings.warn(
+                "the in-scan stats engine is not available on the "
+                "per-stage eager GSPMD fallback path; stats stay disarmed",
+                RuntimeWarning,
+                stacklevel=2,
+            )
+            self._stats_engine = None
+            return
+        with self._scope():
+            self.stats_state = self._stats_engine.init_state()
+            self._stats_tick = jnp.zeros((1,), jnp.int32)
+
+    def reset_stats(self) -> None:
+        """Zero the running sums + sample tick (a fresh averaging window)."""
+        import jax.numpy as jnp
+
+        if not self.stats_armed:
+            return
+        with self._scope():
+            self.stats_state = self._stats_engine.init_state()
+            self._stats_tick = jnp.zeros((1,), jnp.int32)
+
+    @property
+    def stats_engine(self):
+        """The armed :class:`~rustpde_mpi_tpu.models.stats.StatsEngine`
+        (None when disarmed) — public surface for the runner/scheduler."""
+        return self._stats_engine
+
+    @property
+    def stats_armed(self) -> bool:
+        return self._stats_engine is not None and self.stats_state is not None
+
+    def stats_health_async(self):
+        """Dispatch the compiled :data:`~rustpde_mpi_tpu.models.stats
+        .HEALTH_NAMES` readout over the running sums and return an
+        observable future — the runner resolves it one boundary later and
+        exports gauges / typed journal events (``resolution_warning``,
+        ``budget_drift``)."""
+        from ..utils.io_pipeline import ObservableFuture
+
+        if not self.stats_armed:
+            raise RuntimeError("stats_health_async needs an armed stats engine")
+        with self._scope():
+            return ObservableFuture(
+                self._stats_health_fn(self.stats_state),
+                convert=lambda vals: tuple(
+                    np.asarray(v) for v in vals  # lint-ok: RPD005 health scalars are replicated reductions
+                ),
+            )
+
+    def stats_summary(self) -> dict | None:
+        """Synchronous health readout as a dict (None when disarmed)."""
+        if not self.stats_armed:
+            return None
+        from .stats import HEALTH_NAMES
+
+        vals = self.stats_health_async().result()
+        return {
+            name: (float(v) if np.ndim(v) == 0 else [float(x) for x in v])
+            for name, v in zip(HEALTH_NAMES, vals)
+        }
+
+    def stats_host_items(self) -> list:
+        """Gathered-snapshot rows for the stats leaves
+        (:meth:`StatsEngine.host_items`); empty when disarmed."""
+        if not self.stats_armed:
+            return []
+        return self._stats_engine.host_items(self.stats_state, self._stats_tick)
+
+    def apply_restored_stats(self, data: dict | None) -> None:
+        """Install stats leaves read back from a gathered snapshot (keys =
+        leaf names + ``tick``) via :meth:`StatsEngine.restore_state`:
+        ``None``/missing leaves reset to zero — a checkpoint written before
+        stats were armed restarts the averaging window instead of failing
+        the restore."""
+        if not self.stats_armed:
+            return
+        with self._scope():
+            self.stats_state, self._stats_tick = (
+                self._stats_engine.restore_state(
+                    data, k=self.k if hasattr(self, "k") else None
+                )
+            )
+
     def get_time(self) -> float:
         return self.time
 
@@ -541,6 +773,12 @@ class CampaignModelBase:
         "_sent_cc",
         "_sent_consts",
         "_step_n_sent",
+        "_stats_cc",
+        "_stats_consts",
+        "_step_n_stats",
+        "_stats_health_cc",
+        "_stats_health_consts",
+        "_stats_health_fn",
     )
 
     def _dt_artifacts(self) -> dict:
@@ -639,11 +877,29 @@ class CampaignModelBase:
     def snapshot_state_items(self) -> list:
         """``(name, device_array)`` for every state leaf the sharded
         checkpoint must carry — the full restart set, generic over the
-        state NamedTuple."""
-        return [
+        state NamedTuple.  With the stats engine armed the running sums +
+        sample tick join the set, so long-horizon averages ride the
+        two-phase sharded checkpoints and survive kill/resume bit-exactly."""
+        items = [
             (f"state/{name}", getattr(self.state, name))
             for name in self.state._fields
         ]
+        if self.stats_armed:
+            items += [
+                (f"stats/{name}", getattr(self.stats_state, name))
+                for name in self.stats_state._fields
+            ]
+            items.append(("stats/tick", self._stats_tick))
+        return items
+
+    def _split_restored_stats(self, updates: dict) -> None:
+        """Pull the stats leaves out of a sharded-restore ``updates`` dict
+        (missing ones reset to zero — an older checkpoint restarts the
+        averaging window) and install them; the remaining entries are the
+        state leaves the caller installs."""
+        if not self.stats_armed:
+            return
+        self.apply_restored_stats(self._stats_engine.split_restored(updates))
 
     def snapshot_root_items(self) -> list:
         """Replicated host-side data for the sharded manifest root."""
@@ -654,7 +910,11 @@ class CampaignModelBase:
 
     def apply_restored_state(self, updates: dict, attrs: dict, root: dict) -> None:
         """Install state leaves assembled by the sharded reader (already
-        placed in this model's target layout) + the manifest's time."""
+        placed in this model's target layout) + the manifest's time.  Stats
+        leaves (engine armed) are split off first — restored exactly when
+        the checkpoint carries them, reset to zero when it predates the
+        arming."""
+        self._split_restored_stats(updates)
         self.state = self.state._replace(**updates)
         self.time = float(np.asarray(root["time"]))
         self._obs_cache = None

@@ -59,6 +59,8 @@ class NavierEnsemble(Integrate):
     # ensembles fully synchronous
     io_pipeline = None
     io_overlap = False
+    # journal hook — see CampaignModelBase.journal_writer
+    journal_writer = None
 
     def __init__(self, model, states):
         if hasattr(states, "_fields"):  # a state pytree, maybe pre-stacked
@@ -93,11 +95,16 @@ class NavierEnsemble(Integrate):
         # (reproducible recovery runs); None falls back to per-call seeds
         self.respawn_seed: int | None = None
         self._respawn_rng = None
+        # in-scan stats (models/stats.py): per-member running sums with a
+        # leading K axis, armed when the template model's engine is
+        self.stats_state = None
+        self._stats_tick = None
         self._compile_entry_points()
         with model._scope():
             self.state = stacked
             self.mask = self._finite_mask(stacked)
             self.steps_done = jnp.zeros((self.k,), jnp.int32)
+            self._init_stats_state()
 
     # -- construction --------------------------------------------------------
 
@@ -182,13 +189,21 @@ class NavierEnsemble(Integrate):
             self.model.state = keep
 
     def set_member(self, i: int, state: NavierState) -> None:
-        """Replace member ``i``'s state (and re-derive its mask/counter)."""
+        """Replace member ``i``'s state (and re-derive its mask/counter).
+        With the stats engine armed the member's running sums reset too —
+        a refilled lane is a NEW trajectory (the serve scheduler's
+        per-request averaging window starts at claim time)."""
         with self.model._scope():
             self.state = jax.tree.map(
                 lambda st, leaf: st.at[i].set(leaf), self.state, state
             )
             self.mask = self.mask.at[i].set(self.model._scan_ok(state))
             self.steps_done = self.steps_done.at[i].set(0)
+            if self.stats_state is not None:
+                zero = self.model.stats_engine.init_state()
+                self.stats_state = jax.tree.map(
+                    lambda full, z: full.at[i].set(z), self.stats_state, zero
+                )
         self._obs_cache = None
 
     def get_field(self, name: str, member: int) -> np.ndarray:
@@ -257,6 +272,8 @@ class NavierEnsemble(Integrate):
         obs_cc = model._obs_cc
         self.recompile_count += 1
         self._step_n_sent = None
+        self._step_n_stats = None
+        self._stats_health_fn = None
 
         if model._gspmd_split_sep_fallback():
             # same poisoned layout the single-run guard reroutes (fused
@@ -359,8 +376,86 @@ class NavierEnsemble(Integrate):
         obs_jit = jax.jit(jax.vmap(obs_cc, in_axes=(None, 0)))
         self._obs_fn = lambda st: obs_jit(model._obs_consts, st)
 
+        if model._stats_cc is not None:
+            self._compile_stats_entry_points()
+
         if model._sent_cc is not None:
             self._compile_sentinel_entry_points()
+
+    def _compile_stats_entry_points(self) -> None:
+        """Vmapped stats-carrying chunk (template model's ``set_stats``):
+        the per-member running sums ride the carry with a leading K axis, a
+        SHARED scalar sample tick drives the stride cond (one real branch,
+        not a per-member select), and accumulation commits per member only
+        where the step itself commits — a frozen member's averages freeze
+        with it.  Pure consumers of the stepped states: the member
+        trajectories stay bit-identical to the stats-off chunk."""
+        model = self.model
+        step_cc = model._step_cc
+        stats_cc = model._stats_cc
+        stride = int(model.stats_engine.stride)
+
+        def ens_step_n_stats(consts, sconsts, states, ss, tick, mask, done, n: int):
+            vstep = jax.vmap(lambda s: step_cc(consts, s))
+            vcommit = jax.vmap(model._scan_commit_ok)
+            vaccum = jax.vmap(lambda s, st: stats_cc(sconsts, s, st))
+
+            def advance(carry):
+                st, ss, tk, ok, dn = carry
+                st2 = vstep(st)
+                commit = ok & vcommit(st2)
+                ok2 = ok & self._finite_mask(st2)
+                tk2 = tk + 1
+
+                def do_accum(ss):
+                    ss_new = vaccum(ss, st2)
+
+                    def sel(new, old):
+                        m = jnp.reshape(
+                            commit, commit.shape + (1,) * (new.ndim - 1)
+                        )
+                        return jnp.where(m, new, old)
+
+                    return jax.tree.map(sel, ss_new, ss)
+
+                ss2 = jax.lax.cond(
+                    (tk2[0] % stride) == 0, do_accum, lambda s: s, ss
+                )
+
+                def freeze(new, old):
+                    m = jnp.reshape(commit, commit.shape + (1,) * (new.ndim - 1))
+                    return jnp.where(m, new, old)
+
+                return (
+                    jax.tree.map(freeze, st2, st),
+                    ss2,
+                    tk2,
+                    ok2,
+                    dn + commit.astype(jnp.int32),
+                )
+
+            def body(carry, _):
+                carry2 = jax.lax.cond(
+                    jnp.any(carry[3]), advance, lambda c: c, carry
+                )
+                return carry2, None
+
+            (st, ss, tk, mk, dn), _ = jax.lax.scan(
+                body, (states, ss, tick, mask, done), None, length=n
+            )
+            return st, ss, tk, mk, dn
+
+        stats_jit = jax.jit(
+            ens_step_n_stats,
+            static_argnames=("n",),
+            donate_argnums=(2, 3, 4, 5, 6),
+        )
+        self._step_n_stats = lambda st, ss, tk, mk, dn, n: stats_jit(
+            model._step_consts, model._stats_consts, st, ss, tk, mk, dn, n=n
+        )
+
+        h_jit = jax.jit(jax.vmap(model._stats_health_cc, in_axes=(None, 0)))
+        self._stats_health_fn = lambda ss: h_jit(model._stats_health_consts, ss)
 
     def _compile_sentinel_entry_points(self) -> None:
         """Vmapped sentinel chunk (stability governor, utils/governor.py):
@@ -374,13 +469,25 @@ class NavierEnsemble(Integrate):
         model = self.model
         sent_cc = model._sent_cc
         ceiling = float(model._stability.max_cfl)
+        # stats engine armed: running sums + shared tick appended to the
+        # carry (after the sentinel slots — fetch indices stay put); a
+        # member samples only where its step commits under the ceiling
+        stats_cc = model._stats_cc
+        stats_stride = (
+            int(model.stats_engine.stride) if stats_cc is not None else 0
+        )
 
-        def ens_step_n_sent(consts, carry, n: int):
+        def ens_step_n_sent(consts, sconsts, carry, n: int):
             vstep = jax.vmap(lambda s: sent_cc(consts, s))
             vcommit = jax.vmap(model._scan_commit_ok)
+            vaccum = (
+                jax.vmap(lambda s, st: stats_cc(sconsts, s, st))
+                if stats_cc is not None
+                else None
+            )
 
             def advance(carry):
-                st, fin, cok, dn, cflm, gm, dvm, kep = carry
+                st, fin, cok, dn, cflm, gm, dvm, kep = carry[:8]
                 st2, (cfl, ke, dv) = vstep(st)
                 active = fin & cok
                 fin2 = jnp.where(active, self._finite_mask(st2), fin)
@@ -397,7 +504,7 @@ class NavierEnsemble(Integrate):
                     return jnp.where(active, jnp.maximum(old, new), old)
 
                 growth = jnp.where(kep > 0.0, ke / kep, 1.0)
-                return (
+                out = (
                     jax.tree.map(freeze, st2, st),
                     fin2,
                     cok2,
@@ -407,6 +514,26 @@ class NavierEnsemble(Integrate):
                     upd(dvm, dv),
                     jnp.where(active, ke, kep),
                 )
+                if vaccum is not None:
+                    ss, tk = carry[8], carry[9]
+                    tk2 = tk + 1
+
+                    def do_accum(ss):
+                        ss_new = vaccum(ss, st2)
+
+                        def sel(new, old):
+                            m = jnp.reshape(
+                                keep, keep.shape + (1,) * (new.ndim - 1)
+                            )
+                            return jnp.where(m, new, old)
+
+                        return jax.tree.map(sel, ss_new, ss)
+
+                    ss2 = jax.lax.cond(
+                        (tk2[0] % stats_stride) == 0, do_accum, lambda s: s, ss
+                    )
+                    out = out + (ss2, tk2)
+                return out
 
             def body(carry, _):
                 carry2 = jax.lax.cond(
@@ -418,9 +545,11 @@ class NavierEnsemble(Integrate):
             return final
 
         sent_jit = jax.jit(
-            ens_step_n_sent, static_argnames=("n",), donate_argnums=(1,)
+            ens_step_n_sent, static_argnames=("n",), donate_argnums=(2,)
         )
-        self._step_n_sent = lambda c, n: sent_jit(model._sent_consts, c, n=n)
+        self._step_n_sent = lambda c, n: sent_jit(
+            model._sent_consts, model._stats_consts, c, n=n
+        )
 
     def _make_step(self):
         """vmapped single-member step — profiling.step_flops introspects this
@@ -454,13 +583,39 @@ class NavierEnsemble(Integrate):
         if self._step_n_sent is not None:
             return self._update_n_sentinel(n)
         with self.model._scope():
-            carry = jax.tree.map(
-                jnp.copy, (self.state, self.mask, self.steps_done)
-            )
-            carry = run_scanned(
-                lambda c, k: self._step_n(c[0], c[1], c[2], k), carry, n
-            )
-            self.state, self.mask, self.steps_done = carry
+            if self._step_n_stats is not None:
+                carry = jax.tree.map(
+                    jnp.copy,
+                    (
+                        self.state,
+                        self.stats_state,
+                        self._stats_tick,
+                        self.mask,
+                        self.steps_done,
+                    ),
+                )
+                carry = run_scanned(
+                    lambda c, k: self._step_n_stats(
+                        c[0], c[1], c[2], c[3], c[4], k
+                    ),
+                    carry,
+                    n,
+                )
+                (
+                    self.state,
+                    self.stats_state,
+                    self._stats_tick,
+                    self.mask,
+                    self.steps_done,
+                ) = carry
+            else:
+                carry = jax.tree.map(
+                    jnp.copy, (self.state, self.mask, self.steps_done)
+                )
+                carry = run_scanned(
+                    lambda c, k: self._step_n(c[0], c[1], c[2], k), carry, n
+                )
+                self.state, self.mask, self.steps_done = carry
         self.time += n * self.dt
         self._obs_cache = None
         return None
@@ -491,6 +646,7 @@ class NavierEnsemble(Integrate):
             )
         self._pre_div_latch = False
         rdt = config.real_dtype()
+        stats_on = self.model._stats_cc is not None
         done_before = self.steps_done  # fetched with the sentinel scalars
         with self.model._scope():
             # distinct buffers per slot: the dispatch donates the whole
@@ -505,10 +661,24 @@ class NavierEnsemble(Integrate):
                 jnp.zeros((self.k,), rdt),  # per-member |div| max
                 jnp.zeros((self.k,), rdt),  # per-member previous-step ke
             )
+            if stats_on:
+                carry = carry + (
+                    jax.tree.map(jnp.copy, self.stats_state),
+                    jnp.copy(self._stats_tick),
+                )
             carry = run_scanned(lambda c, k: self._step_n_sent(c, k), carry, n)
-        st, fin, cok, dn, cflm, gm, dvm, kep = carry
-        snapshot = (self.state, self.mask, self.steps_done, self.time)
+        st, fin, cok, dn, cflm, gm, dvm, kep = carry[:8]
+        snapshot = (
+            self.state,
+            self.mask,
+            self.steps_done,
+            self.time,
+            self.stats_state,
+            self._stats_tick,
+        )
         self.state, self.mask, self.steps_done = st, fin, dn  # provisional
+        if stats_on:
+            self.stats_state, self._stats_tick = carry[8], carry[9]
         self.time += n * self.dt
         self._obs_cache = None
         dt = self.dt
@@ -521,8 +691,16 @@ class NavierEnsemble(Integrate):
             pre_div = bool(pinned.any())
             if pre_div:
                 # in-memory rollback of the whole chunk: state/mask/counters
-                # are the un-donated chunk-start snapshots — put them back
-                (self.state, self.mask, self.steps_done, self.time) = snapshot
+                # (and the stats sums) are the un-donated chunk-start
+                # snapshots — put them back
+                (
+                    self.state,
+                    self.mask,
+                    self.steps_done,
+                    self.time,
+                    self.stats_state,
+                    self._stats_tick,
+                ) = snapshot
                 self._pre_div_latch = True
                 self._obs_cache = None
             delta = dn_h - before_h
@@ -565,6 +743,91 @@ class NavierEnsemble(Integrate):
         """Acknowledge a ``pre_divergence`` catch (governor handled it)."""
         self._pre_div_latch = False
 
+    # -- in-scan physics statistics (models/stats.py) --------------------------
+
+    def _init_stats_state(self) -> None:
+        """Zeroed per-member running sums when the template model's engine
+        is armed (callers hold the model scope)."""
+        if self._step_n_stats is None:
+            self.stats_state = None
+            self._stats_tick = None
+            return
+        self.stats_state = self.model.stats_engine.init_state(k=self.k)
+        self._stats_tick = jnp.zeros((1,), jnp.int32)
+
+    def set_stats(self, cfg) -> None:
+        """Arm/disarm the in-scan stats engine on the shared template model
+        and re-vmap the ensemble entry points on top; per-member running
+        sums zero-initialize (a fresh averaging window for every member)."""
+        self.model.set_stats(cfg)
+        self._dt_cache.clear()
+        self._compile_entry_points()
+        with self.model._scope():
+            self._init_stats_state()
+
+    def reset_stats(self) -> None:
+        """Zero every member's running sums + the shared sample tick."""
+        with self.model._scope():
+            self._init_stats_state()
+
+    @property
+    def stats_engine(self):
+        """The template model's engine (None when disarmed)."""
+        return self.model.stats_engine
+
+    @property
+    def stats_armed(self) -> bool:
+        return self._step_n_stats is not None and self.stats_state is not None
+
+    def stats_health_async(self):
+        """Vmapped :data:`~rustpde_mpi_tpu.models.stats.HEALTH_NAMES`
+        readout — an observable future of (K,) arrays, one health vector
+        per member (the serve scheduler summarizes a finished member's
+        entry into its done record)."""
+        from ..utils.io_pipeline import ObservableFuture
+
+        if not self.stats_armed:
+            raise RuntimeError("stats_health_async needs an armed stats engine")
+        with self.model._scope():
+            return ObservableFuture(
+                self._stats_health_fn(self.stats_state),
+                convert=lambda vals: tuple(np.asarray(v) for v in vals),
+            )
+
+    def stats_summary(self) -> dict | None:
+        """Synchronous per-member health readout (None when disarmed):
+        each name maps to a length-K list."""
+        if not self.stats_armed:
+            return None
+        from .stats import HEALTH_NAMES
+
+        vals = self.stats_health_async().result()
+        return {
+            name: [float(x) for x in np.asarray(v).reshape(-1)]
+            for name, v in zip(HEALTH_NAMES, vals)
+        }
+
+    def stats_host_items(self) -> list:
+        """Gathered-snapshot rows for the stacked stats leaves
+        (:meth:`StatsEngine.host_items`); empty when disarmed."""
+        if not self.stats_armed:
+            return []
+        return self.model.stats_engine.host_items(
+            self.stats_state, self._stats_tick
+        )
+
+    def apply_restored_stats(self, data: dict | None) -> None:
+        """Install stacked stats leaves from a gathered snapshot (leading
+        axis = the file's member count, which the caller already installed
+        as ``self.k``) via :meth:`StatsEngine.restore_state`;
+        ``None``/missing leaves reset to zero."""
+        if not self.stats_armed:
+            return
+        with self.model._scope():
+            self.stats_state, self._stats_tick = (
+                self.model.stats_engine.restore_state(data, k=self.k)
+            )
+
     @property
     def pre_divergence_latched(self) -> bool:
         """True while an unacknowledged sentinel catch latches ``exit()`` —
@@ -590,7 +853,13 @@ class NavierEnsemble(Integrate):
         return self.dt
 
     # swapped per dt change, cached per rung like Navier2D._DT_ARTIFACTS
-    _DT_ARTIFACTS = ("_step_n", "_obs_fn", "_step_n_sent")
+    _DT_ARTIFACTS = (
+        "_step_n",
+        "_obs_fn",
+        "_step_n_sent",
+        "_step_n_stats",
+        "_stats_health_fn",
+    )
 
     def set_dt(self, dt: float) -> None:
         """Propagate a dt change (the governor's ladder / divergence-retry
@@ -819,11 +1088,30 @@ class NavierEnsemble(Integrate):
     def snapshot_state_items(self) -> list:
         """``(name, device_array)`` per batched state leaf (leading K axis
         rides along as replicated batch under the pencil spec) — see
-        ``Navier2D.snapshot_state_items``."""
-        return [
+        ``Navier2D.snapshot_state_items``.  Armed stats leaves join the set
+        so per-member running averages survive kill/resume bit-exactly."""
+        items = [
             (f"state/{name}", getattr(self.state, name))
             for name in self.state._fields
         ]
+        if self.stats_armed:
+            items += [
+                (f"stats/{name}", getattr(self.stats_state, name))
+                for name in self.stats_state._fields
+            ]
+            items.append(("stats/tick", self._stats_tick))
+        return items
+
+    def _split_restored_stats(self, updates: dict) -> None:
+        """Sharded-restore side of the stats leaves (mirrors
+        ``CampaignModelBase._split_restored_stats``): present leaves
+        install exactly, missing ones zero — then the caller installs the
+        remaining state leaves."""
+        if not self.stats_armed:
+            return
+        self.apply_restored_stats(
+            self.model.stats_engine.split_restored(updates)
+        )
 
     def snapshot_root_items(self) -> list:
         """Replicated manifest-root data: time, params AND the ensemble
@@ -843,6 +1131,7 @@ class NavierEnsemble(Integrate):
         format is exact (bit-equal restore), so the member count must match
         — the reader rejects K mismatches before assembly (K-elastic
         restarts go through the gathered per-member layout)."""
+        self._split_restored_stats(updates)
         self.state = self.state._replace(**updates)
         self.mask = jnp.asarray(np.asarray(root["alive"], dtype=bool))
         self.steps_done = jnp.asarray(np.asarray(root["steps_done"]), jnp.int32)

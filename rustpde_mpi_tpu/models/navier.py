@@ -590,6 +590,11 @@ class Navier2D(CampaignModelBase, Integrate):
         model.params.update(cfg.params)
         if getattr(cfg, "stability", None) is not None:
             model.set_stability(cfg.stability)
+        stats_cfg = getattr(cfg, "stats", None)
+        if stats_cfg is None and config.env_get("RUSTPDE_STATS") == "1":
+            stats_cfg = config.StatsConfig()
+        if stats_cfg is not None:
+            model.set_stats(stats_cfg)
         return model
 
     def _build_bc_fields(self, xs: np.ndarray, ys: np.ndarray) -> None:

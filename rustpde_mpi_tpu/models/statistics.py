@@ -76,7 +76,20 @@ class Statistics:
         (statistics.rs:84-108)."""
         time = float(model.time)
         if time < self.tot_time:
+            # typed, countable failure instead of only a swallowed print: a
+            # mismatched restart silently NOT updating the averages is the
+            # kind of loss a production run must be able to alert on
+            from .stats import report_stats_event
+
             print(f"Statistics time mismatch (navier < stat): {time} < {self.tot_time}")
+            report_stats_event(
+                model,
+                {
+                    "event": "stats_mismatch",
+                    "navier_time": time,
+                    "stat_time": float(self.tot_time),
+                },
+            )
             return
         with model._scope():
             that_h = model.temp_space.to_ortho(model.state.temp)

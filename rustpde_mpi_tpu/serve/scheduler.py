@@ -626,6 +626,16 @@ class SimServer:
             # masks); the dt response is the scheduler's per-bucket ladder
             # (_settle_predivergence), never a batch-wide governor
             model.set_stability(self.cfg.stability)
+        if (
+            self.cfg.stats is not None
+            and getattr(model, "MODEL_KIND", "") == "dns"
+        ):
+            # in-scan per-member physics stats (models/stats.py): armed
+            # before the ensemble vmaps too; each done record then carries
+            # the member's health summary.  A lane refill (set_member)
+            # resets that member's averaging window — per-request stats
+            # start at claim time.
+            model.set_stats(self.cfg.stats)
         # per-member step flops for the live MFU gauge: the trace-only jaxpr
         # dot count (no extra compile; the entry points were just built)
         try:
@@ -1319,6 +1329,16 @@ class SimServer:
         if plan["finished"]:
             obs_fut = ens.get_observables_async()  # one dispatch, all hosts
             names = tuple(ens.observable_names)
+            # per-request physics-stats summary (cfg.stats armed): the
+            # health readout is captured HERE, before any lane is released
+            # or refilled (a refill zeroes that member's sums) — collective
+            # dispatch on all hosts, like the observables
+            stats_fut = stats_names = None
+            if getattr(ens, "stats_armed", False):
+                from ..models.stats import HEALTH_NAMES
+
+                stats_fut = ens.stats_health_async()
+                stats_names = HEALTH_NAMES
             batch = []
             for d in plan["finished"]:
                 s = slots[d["slot"]]
@@ -1327,6 +1347,8 @@ class SimServer:
                         "slot": s.index,
                         "req": s.req,
                         "names": names,
+                        "stats_fut": stats_fut,
+                        "stats_names": stats_names,
                         "steps": int(d["steps"]),
                         "finished_wall": time.time(),
                         "step": runner.step,
@@ -1542,6 +1564,16 @@ class SimServer:
                 result["admission_to_first_observable_s"] = round(
                     first_obs_s, 6
                 )
+                # per-request physics-stats summary (cfg.stats): the
+                # member's health vector at completion time — samples, Nu
+                # estimators, budget residuals, spectral-tail fractions
+                sfut = item.get("stats_fut")
+                if sfut is not None:
+                    svals = sfut.result()
+                    result["stats"] = {
+                        name: float(np.asarray(v).reshape(-1)[i])  # lint-ok: RPD005 future already converted to host numpy
+                        for name, v in zip(item["stats_names"], svals)
+                    }
                 self.queue.complete(req, result)
                 self._completed += 1
                 _tm.counter(

@@ -636,6 +636,12 @@ def snapshot_to_host(model, step: int | None = None) -> HostSnapshot:
     datasets.append(("time", np.asarray(float(model.time), dtype=np.float64), "raw"))
     for key, value in model.params.items():
         datasets.append((key, np.asarray(float(value), dtype=np.float64), "raw"))
+    # armed in-scan stats (models/stats.py): running sums + sample tick as
+    # exact-dtype raw datasets, so a resume restores the averages bit-equal
+    stats_items = getattr(model, "stats_host_items", None)
+    if stats_items is not None:
+        with model._scope():
+            datasets.extend(stats_items())
     return HostSnapshot(
         datasets=datasets, step=step, time=float(model.time), dt=float(model.dt)
     )
@@ -684,6 +690,10 @@ def ensemble_snapshot_to_host(ens, step: int | None = None) -> HostSnapshot:
     datasets.append(("steps_done", steps_done, "raw"))
     for key, value in model.params.items():
         datasets.append((key, np.asarray(float(value), dtype=np.float64), "raw"))
+    stats_items = getattr(ens, "stats_host_items", None)
+    if stats_items is not None:
+        with model._scope():
+            datasets.extend(stats_items())
     return HostSnapshot(
         datasets=datasets, step=step, time=float(ens.time), dt=float(ens.dt)
     )
@@ -788,8 +798,27 @@ def read_ensemble_snapshot(ens, filename: str) -> None:
                 np.asarray(h5["steps_done"]), dtype=jnp.int32
             )
         ens.time = float(np.asarray(h5["time"]))
+        _restore_stats(ens, h5)
     ens._obs_cache = None
     print(f" <== {filename} ({k} members)")
+
+
+def _read_stats_group(h5) -> dict | None:
+    """The ``stats_state/`` raw datasets of a gathered snapshot (None when
+    the file predates the stats engine — restores then reset the averaging
+    window instead of failing)."""
+    if "stats_state" not in h5:
+        return None
+    grp = h5["stats_state"]
+    return {name: np.asarray(grp[name]) for name in grp}
+
+
+def _restore_stats(pde, h5) -> None:
+    """Install a gathered snapshot's stats leaves on a stats-armed model/
+    ensemble (no-op otherwise)."""
+    if not getattr(pde, "stats_armed", False):
+        return
+    pde.apply_restored_stats(_read_stats_group(h5))
 
 
 def read_snapshot(model, filename: str) -> None:
@@ -823,6 +852,7 @@ def read_snapshot(model, filename: str) -> None:
             updates[attr] = jnp.asarray(vhat, dtype=space.spectral_dtype())
         model.state = model.state._replace(**updates)
         model.time = float(np.asarray(h5["time"]))
+        _restore_stats(model, h5)
     print(f" <== {filename}")
 
 
@@ -1349,6 +1379,16 @@ def read_sharded_snapshot(pde, filename: str) -> None:
         for name, arr in pde.snapshot_state_items():
             dmeta = meta["datasets"].get(name)
             if dmeta is None:
+                if name.startswith("stats/"):
+                    # checkpoint written before the stats engine was armed:
+                    # the averaging window restarts (apply_restored_state
+                    # zero-fills the absent leaves) — the STATE restore
+                    # stays bit-exact either way
+                    print(
+                        f"sharded checkpoint lacks {name!r}; running "
+                        "averages restart from zero"
+                    )
+                    continue
                 raise CheckpointError(filename, f"manifest lacks dataset {name!r}")
             if tuple(dmeta["shape"]) != tuple(arr.shape):
                 raise CheckpointError(

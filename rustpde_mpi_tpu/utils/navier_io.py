@@ -103,7 +103,20 @@ def callback(
             try:
                 stats.write("data/statistics.h5")
             except OSError as exc:
+                # never fatal (reference semantics) but no longer silent: a
+                # typed journal event + telemetry counter replace the
+                # swallowed print (models/stats.report_stats_event)
+                from ..models.stats import report_stats_event
+
                 print(f"unable to write statistics: {exc}")
+                report_stats_event(
+                    model,
+                    {
+                        "event": "stats_write_failed",
+                        "path": "data/statistics.h5",
+                        "error": str(exc),
+                    },
+                )
 
     if suppress_io:
         return

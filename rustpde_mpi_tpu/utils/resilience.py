@@ -369,6 +369,16 @@ class ResilientRunner:
         self._metrics_dumper: MetricsDumper | None = None
         self._exit_disarm = None
 
+        # physics-health streaming (models/stats.py, armed via the model's
+        # set_stats): one health future in flight, resolved a boundary
+        # later (lag=1 — no fence), exported as gauges + typed journal
+        # events with crossing latches (warn once per excursion, re-arm
+        # after the signal halves)
+        self._stats_health_pending = None
+        self._stats_res_latched = False
+        self._stats_budget_latched = False
+        self._saved_pde_journal = None
+
         self.step = 0  # global step counter (survives resume via ckpt attrs)
         self.attempt = 0  # divergence retries so far
         self.resumed = False  # set by session(): a checkpoint was restored
@@ -1167,6 +1177,7 @@ class ResilientRunner:
                 self._journal({"event": "profile_capture", **capture})
         if self._metrics_dumper is not None:
             self._metrics_dumper.maybe_dump(step=self.step)
+        self._stats_boundary()
         if self._preempt_agreed():
             return True  # integrate() returns "stopped"; run() checkpoints
         due = False
@@ -1183,6 +1194,137 @@ class ResilientRunner:
         if self._root_decides(due):
             self._checkpoint("cadence")
         return False
+
+    # -- physics-health streaming (models/stats.py) ---------------------------
+
+    def _stats_boundary(self) -> None:
+        """Per-boundary health streaming for a stats-armed model: resolve
+        the PREVIOUS boundary's health future (lag=1 — by now the scalars
+        are long since on host, so this fences nothing), export the gauges
+        and the threshold-crossing journal events, then dispatch a fresh
+        readout.  Every host dispatches (the readout is a collective
+        program on a mesh); only root journals."""
+        if not getattr(self.pde, "stats_armed", False):
+            self._stats_health_pending = None
+            return
+        fut = self._stats_health_pending
+        self._stats_health_pending = None
+        if fut is not None:
+            try:
+                self._stats_health_report(fut.result())
+            except Exception:
+                pass  # health telemetry must never kill the run
+        try:
+            self._stats_health_pending = self.pde.stats_health_async()
+        except Exception:
+            self._stats_health_pending = None
+
+    def _stats_health_report(self, vals) -> None:
+        """Gauges + typed events from one resolved health vector (ensemble
+        vectors reduce as the max over members — the worst member is the
+        one the alert is about)."""
+        from ..models.stats import HEALTH_NAMES
+
+        arrs, d = {}, {}
+        for name, v in zip(HEALTH_NAMES, vals):
+            arr = np.asarray(v, dtype=np.float64).reshape(-1)  # lint-ok: RPD005 health futures resolve to host numpy scalars
+            arrs[name] = arr
+            # worst-member reduction: BL point counts are a LOW-is-bad
+            # signal (too few grid points in the layer), everything else
+            # is HIGH-is-bad — both reduce to the worst member
+            red = np.min if name.startswith("bl_") else np.max
+            d[name] = float(red(arr)) if arr.size else 0.0
+        if d["samples"] < 1.0:
+            return  # nothing accumulated yet — every readout would be 0
+        # the budget alert must be SELF-CONSISTENT: every budget field in
+        # the event comes from the one worst member (argmax nu_residual),
+        # not a per-field max that mixes members into numbers whose own
+        # plate/flux gap would not reproduce the reported residual
+        worst_m = (
+            int(arrs["nu_residual"].argmax()) if arrs["nu_residual"].size else 0
+        )
+        budget = {
+            name: float(arrs[name][worst_m])
+            for name in (
+                "nu_residual", "ke_residual",
+                "nu_plate_avg", "nu_flux_avg", "samples",
+            )
+        }
+        tails = {
+            ("temp", "x"): d["tail_t_x"],
+            ("temp", "y"): d["tail_t_y"],
+            ("ux", "x"): d["tail_ux_x"],
+            ("ux", "y"): d["tail_ux_y"],
+            ("uy", "x"): d["tail_uy_x"],
+            ("uy", "y"): d["tail_uy_y"],
+        }
+        for (field, axis), val in tails.items():
+            _tm.gauge(
+                "stats_tail_energy_fraction",
+                "energy fraction in the top third of the ortho spectrum",
+                field=field,
+                axis=axis,
+            ).set(val)
+        _tm.gauge(
+            "stats_bl_points", "grid points inside the boundary layer",
+            layer="thermal",
+        ).set(d["bl_thermal_pts"])
+        _tm.gauge(
+            "stats_bl_points", "grid points inside the boundary layer",
+            layer="viscous",
+        ).set(d["bl_visc_pts"])
+        _tm.gauge(
+            "stats_budget_residual", "budget-closure residual", budget="ke"
+        ).set(d["ke_residual"])
+        _tm.gauge(
+            "stats_budget_residual", "budget-closure residual", budget="nu"
+        ).set(d["nu_residual"])
+        _tm.gauge("stats_samples", "in-scan stats samples accumulated").set(
+            d["samples"]
+        )
+        eng = self.pde.stats_engine
+        tail_max = max(tails.values())
+        worst = max(tails, key=tails.get)
+        if tail_max > eng.tail_warn:
+            if not self._stats_res_latched:
+                self._stats_res_latched = True
+                _tm.counter(
+                    "stats_resolution_warnings_total",
+                    "spectral-tail under-resolution warnings",
+                ).inc()
+                self._journal(
+                    {
+                        "event": "resolution_warning",
+                        "field": worst[0],
+                        "axis": worst[1],
+                        "tail_fraction": tail_max,
+                        "threshold": eng.tail_warn,
+                        "samples": d["samples"],
+                    }
+                )
+        elif tail_max < 0.5 * eng.tail_warn:
+            self._stats_res_latched = False
+        if d["nu_residual"] > eng.budget_warn and d["samples"] >= 2:
+            if not self._stats_budget_latched:
+                self._stats_budget_latched = True
+                _tm.counter(
+                    "stats_budget_drift_total",
+                    "Nu budget-closure drift warnings",
+                ).inc()
+                self._journal(
+                    {
+                        "event": "budget_drift",
+                        "member": worst_m,
+                        "nu_residual": budget["nu_residual"],
+                        "ke_residual": budget["ke_residual"],
+                        "nu_plate_avg": budget["nu_plate_avg"],
+                        "nu_flux_avg": budget["nu_flux_avg"],
+                        "threshold": eng.budget_warn,
+                        "samples": budget["samples"],
+                    }
+                )
+        elif d["nu_residual"] < 0.5 * eng.budget_warn:
+            self._stats_budget_latched = False
 
     # -- divergence recovery -------------------------------------------------
 
@@ -1284,6 +1426,19 @@ class ResilientRunner:
         if install_signals:
             self._install_signals()
         self._setup_io()
+        self._stats_health_pending = None
+        # hand the model the run's journal writer for the session: model-
+        # side statistics failures (stats_mismatch / stats_write_failed,
+        # models/stats.report_stats_event) land as typed events in THIS
+        # run's journal instead of vanishing into stdout (root only — the
+        # journal is root-owned)
+        self._saved_pde_journal = getattr(self.pde, "journal_writer", None)
+        if _is_root():
+            if self._journal_writer is None:
+                self._journal_writer = JournalWriter(self.journal_path)
+            self.pde.journal_writer = self._journal_writer
+            if hasattr(self.pde, "model"):
+                self.pde.model.journal_writer = self._journal_writer
         # telemetry arming (root only: run_dir is shared on multihost):
         # cadenced metrics.jsonl for headless runs + the unclean-exit
         # flight-record hook — disarmed on ANY session exit below (the
@@ -1582,6 +1737,13 @@ class ResilientRunner:
         saved = getattr(self, "_saved_pde_io", None)
         if getattr(self.pde, "io_pipeline", None) is not saved:
             self.pde.io_pipeline = saved
+        # give the model its previous journal hook back (adopted writers
+        # belong to the embedding supervisor; owned ones close below)
+        if getattr(self.pde, "journal_writer", None) is not self._saved_pde_journal:
+            self.pde.journal_writer = self._saved_pde_journal
+            if hasattr(self.pde, "model"):
+                self.pde.model.journal_writer = self._saved_pde_journal
+        self._stats_health_pending = None
         # release the journal handle (reopens lazily if journaled again);
         # an adopted writer belongs to the embedding supervisor — not ours
         if self._journal_writer is not None and self._journal_owned:
@@ -1640,4 +1802,12 @@ class ResilientRunner:
             # overlapped-IO telemetry: background writes, worker seconds,
             # submitter seconds lost to back-pressure
             "io": self._io.stats() if self._io is not None else None,
+            # physics-stats health readout (stats-armed models): the
+            # HEALTH_NAMES scalars — spectral tails, BL point counts,
+            # budget residuals, Nu estimators, sample count
+            "stats": (
+                self.pde.stats_summary()
+                if getattr(self.pde, "stats_armed", False)
+                else None
+            ),
         }

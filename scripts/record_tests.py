@@ -116,6 +116,10 @@ def main() -> int:
         # interpreter mode) — merged into PARITY.json under "pallas_conv"
         # so kernel drift is tracked per-PR like the Nu trajectories
         "pallas_conv": _pallas_conv_parity(),
+        # in-scan stats engine vs the eager legacy accumulator (max rel
+        # diff per accumulated field) — merged into PARITY.json under
+        # "stats" so accumulator drift is tracked per-PR too
+        "stats": _stats_parity(),
         # telemetry inventory (METRICS.json written alongside): the metric
         # names an instrumented run registers — a per-PR record of the
         # observable vocabulary, like the journal schema rows
@@ -257,41 +261,46 @@ print("WORKLOADS_JSON " + json.dumps(solo_ensemble_parity(steps=6)))
 """
 
 
-def _workloads_parity() -> dict | None:
-    """Per-model-kind solo-vs-ensemble parity deltas (max relative state
-    deviation of a K=2 vmapped campaign vs member-wise solo runs, per
-    registered model kind), computed in a CPU child and merged into
-    PARITY.json under ``"workloads"``.  Best-effort: a failure records the
-    error string instead of killing the test record."""
+def _parity_probe(child_src: str, marker: str, key: str, value_key: str) -> dict:
+    """Shared harness behind every PARITY.json probe: run ``child_src`` as
+    a CPU child, parse the ``marker``-prefixed JSON line, and atomically
+    merge the payload under ``key`` next to the Nu-parity trajectories.
+    Best-effort: a failure records the error string instead of killing the
+    test record."""
     try:
         proc = subprocess.run(
-            [sys.executable, "-c", _WORKLOADS_CHILD % {"repo": _REPO}],
+            [sys.executable, "-c", child_src % {"repo": _REPO}],
             capture_output=True,
             text=True,
             timeout=600,
             cwd=_REPO,
         )
         line = next(
-            ln for ln in proc.stdout.splitlines()
-            if ln.startswith("WORKLOADS_JSON ")
+            ln for ln in proc.stdout.splitlines() if ln.startswith(marker)
         )
-        deltas = json.loads(line[len("WORKLOADS_JSON "):])
+        values = json.loads(line[len(marker):])
     except Exception as exc:  # noqa: BLE001 — recording must not fail the run
         return {"error": f"{type(exc).__name__}: {exc}"}
-    payload = {"deltas": deltas, "date": _utc_now()}
-    # merge into PARITY.json next to the Nu-parity trajectories
+    payload = {value_key: values, "date": _utc_now()}
     parity_path = os.path.join(_REPO, "PARITY.json")
     try:
         with open(parity_path) as f:
             parity = json.load(f)
     except (OSError, ValueError):
         parity = {}
-    parity["workloads"] = payload
+    parity[key] = payload
     tmp = f"{parity_path}.{os.getpid()}.tmp"
     with open(tmp, "w") as f:
         json.dump(parity, f, indent=1)
     os.replace(tmp, parity_path)
     return payload
+
+
+def _workloads_parity() -> dict | None:
+    """Per-model-kind solo-vs-ensemble parity deltas (max relative state
+    deviation of a K=2 vmapped campaign vs member-wise solo runs, per
+    registered model kind), merged into PARITY.json under ``"workloads"``."""
+    return _parity_probe(_WORKLOADS_CHILD, "WORKLOADS_JSON ", "workloads", "deltas")
 
 
 _PALLAS_CONV_CHILD = r"""
@@ -341,35 +350,54 @@ print("PALLAS_CONV_JSON " + json.dumps(deltas))
 def _pallas_conv_parity() -> dict | None:
     """Max relative dense-vs-Pallas deviation of the fused convection chain
     per layout (CPU interpreter mode, f64), merged into PARITY.json under
-    ``"pallas_conv"``.  Best-effort like the workloads probe."""
-    try:
-        proc = subprocess.run(
-            [sys.executable, "-c", _PALLAS_CONV_CHILD % {"repo": _REPO}],
-            capture_output=True,
-            text=True,
-            timeout=600,
-            cwd=_REPO,
-        )
-        line = next(
-            ln for ln in proc.stdout.splitlines()
-            if ln.startswith("PALLAS_CONV_JSON ")
-        )
-        deltas = json.loads(line[len("PALLAS_CONV_JSON "):])
-    except Exception as exc:  # noqa: BLE001 — recording must not fail the run
-        return {"error": f"{type(exc).__name__}: {exc}"}
-    payload = {"max_rel_diff": deltas, "date": _utc_now()}
-    parity_path = os.path.join(_REPO, "PARITY.json")
-    try:
-        with open(parity_path) as f:
-            parity = json.load(f)
-    except (OSError, ValueError):
-        parity = {}
-    parity["pallas_conv"] = payload
-    tmp = f"{parity_path}.{os.getpid()}.tmp"
-    with open(tmp, "w") as f:
-        json.dump(parity, f, indent=1)
-    os.replace(tmp, parity_path)
-    return payload
+    ``"pallas_conv"``."""
+    return _parity_probe(
+        _PALLAS_CONV_CHILD, "PALLAS_CONV_JSON ", "pallas_conv", "max_rel_diff"
+    )
+
+
+_STATS_CHILD = r"""
+import json, os, sys
+os.environ["JAX_PLATFORMS"] = "cpu"
+sys.path.insert(0, %(repo)r)
+import numpy as np
+import jax
+jax.config.update("jax_platforms", "cpu")
+from rustpde_mpi_tpu import Navier2D, Statistics
+from rustpde_mpi_tpu.config import StatsConfig
+
+def build():
+    m = Navier2D(17, 17, 1e4, 1.0, 0.01, 1.0, "rbc", periodic=False)
+    m.set_velocity(0.1, 1.0, 1.0)
+    m.set_temperature(0.1, 1.0, 1.0)
+    return m
+
+m = build()
+m.set_stats(StatsConfig(stride=3))
+m.update_n(12)
+twin = build()
+legacy = Statistics(twin, 0.01, 1.0)
+for _ in range(4):
+    twin.update_n(3)
+    legacy.update(twin)
+n = float(np.asarray(m.stats_state.samples).reshape(-1)[0])
+deltas = {}
+for eng, leg in (
+    ("t_sum", "t_avg"), ("ux_sum", "ux_avg"),
+    ("uy_sum", "uy_avg"), ("nusselt_sum", "nusselt"),
+):
+    a = np.asarray(getattr(m.stats_state, eng)) / n
+    b = np.asarray(getattr(legacy, leg))
+    deltas[eng[:-4]] = float(np.abs(a - b).max() / (np.abs(b).max() or 1.0))
+print("STATS_JSON " + json.dumps(deltas))
+"""
+
+
+def _stats_parity() -> dict | None:
+    """Engine-vs-eager-legacy accumulator parity (max relative deviation
+    of the running averages over a matched sampled trajectory), merged
+    into PARITY.json under ``"stats"``."""
+    return _parity_probe(_STATS_CHILD, "STATS_JSON ", "stats", "max_rel_diff")
 
 
 _METRICS_CHILD = r"""
