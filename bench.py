@@ -918,6 +918,200 @@ print(json.dumps({"verify_ok": True, "restore_equal": bool(equal),
         shutil.rmtree(out_dir, ignore_errors=True)
 
 
+def _serve_fleet_leg(run_dir, timeout_s=900):
+    """The serve129 fleet leg (ISSUE 15): 1 stateless proxy + 2 leased
+    replicas on CPU over ONE shared durable queue, mixed-priority traffic
+    submitted through the proxy, one replica SIGKILLed mid-campaign while
+    it holds leases + durable parked continuations.
+
+    Runs on the small 17^2 tier shape on purpose: the leg measures FLEET
+    mechanics (lease break -> reclaim latency, per-class admission-to-
+    first-observable percentiles, zero-lost / resumed-with-state), not
+    step throughput — the single-process soak above already owns that.
+
+    Returns the fleet payload; raises on a broken fleet (the caller
+    records the error and degrades the gates to None, like the mp leg)."""
+    import signal as _signal
+    import subprocess
+    import urllib.request
+
+    import numpy as np
+
+    from rustpde_mpi_tpu.serve import DurableQueue
+    from rustpde_mpi_tpu.utils.journal import read_journal
+
+    n_req = int(os.environ.get("RUSTPDE_FLEET_BENCH_REQUESTS", "10"))
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("RUSTPDE_FAULT", None)
+    driver = os.path.join(_REPO, "examples", "navier_rbc_fleet.py")
+    procs, logs = {}, {}
+
+    def spawn(name, args):
+        logs[name] = open(os.path.join(run_dir, f"{name}.log"), "w")
+        procs[name] = subprocess.Popen(
+            [sys.executable, driver, "--run-dir", run_dir, *args],
+            stdout=logs[name], stderr=subprocess.STDOUT, text=True,
+            env=env, cwd=_REPO,
+        )
+        return procs[name]
+
+    def replica_events(rid):
+        return read_journal(
+            os.path.join(run_dir, "replicas", rid, "journal.jsonl"),
+            on_error="skip",
+        )
+
+    t_start = time.perf_counter()
+    try:
+        spawn("proxy", ["--proxy", "--lease-ttl-s", "3"])
+        addr, deadline = None, time.time() + 120
+        while time.time() < deadline and addr is None:
+            time.sleep(0.2)
+            try:
+                with open(os.path.join(run_dir, "proxy.log")) as fh:
+                    for line in fh:
+                        if line.startswith("{"):
+                            addr = json.loads(line)["address"]
+                            break
+            except OSError:
+                pass
+        if not addr:
+            raise RuntimeError("fleet proxy never bound")
+        base = f"http://{addr[0]}:{addr[1]}"
+        common = [
+            "--replica", "--daemon", "--lease-ttl-s", "3",
+            "--heartbeat-s", "0.2", "--slots", "2", "--chunk-steps", "8",
+            "--ckpt-every-s", "1000",
+        ]
+        spawn("rA", [*common, "--replica-id", "rA"])
+        spawn("rB", [*common, "--replica-id", "rB"])
+
+        def post(payload):
+            req = urllib.request.Request(
+                base + "/requests", data=json.dumps(payload).encode(),
+                method="POST",
+            )
+            with urllib.request.urlopen(req, timeout=30) as resp:
+                return resp.status, json.loads(resp.read())
+
+        classes = ["batch", "best-effort", "interactive"]
+        for seed in range(n_req):
+            pri = classes[seed % 3]
+            body = dict(
+                ra=1e4, pr=1.0, nx=17, ny=17, dt=0.01,
+                horizon=1.6 + 0.08 * seed, seed=seed, priority=pri,
+                tenant=f"t{seed % 2}",
+            )
+            if pri == "interactive":
+                body["deadline_s"] = 120.0
+            code, _ = post(body)
+            if code != 202:
+                raise RuntimeError(f"fleet submit rejected: {code}")
+
+        # SIGKILL whichever replica persisted a mid-flight continuation
+        victim, deadline = None, time.time() + timeout_s
+        while time.time() < deadline and victim is None:
+            time.sleep(0.2)
+            for rid in ("rA", "rB"):
+                if any(
+                    e.get("event") == "continuation_persisted"
+                    and e.get("steps", 0) > 0
+                    for e in replica_events(rid)
+                ):
+                    victim = rid
+                    break
+        if victim is None:
+            raise RuntimeError("no mid-flight continuation ever persisted")
+        procs[victim].send_signal(_signal.SIGKILL)
+        survivor = "rB" if victim == "rA" else "rA"
+
+        queue = DurableQueue(os.path.join(run_dir, "queue"), max_queue=512)
+        deadline = time.time() + timeout_s
+        while time.time() < deadline:
+            counts = queue.counts()
+            if (
+                counts["done"] == n_req
+                and counts["queued"] == 0
+                and counts["running"] == 0
+            ):
+                break
+            time.sleep(0.5)
+        procs[survivor].send_signal(_signal.SIGTERM)
+        procs[survivor].wait(timeout=300)
+        procs["proxy"].send_signal(_signal.SIGTERM)
+        procs["proxy"].wait(timeout=60)
+
+        # per-class admission-to-first-observable percentiles from the
+        # done records (each carries priority + the HA gate clock)
+        per_class: dict = {}
+        done_dir = os.path.join(run_dir, "queue", "done")
+        for name in sorted(os.listdir(done_dir)):
+            with open(os.path.join(done_dir, name)) as fh:
+                res = json.load(fh)["result"]
+            per_class.setdefault(res.get("priority", "batch"), []).append(
+                res["admission_to_first_observable_s"]
+            )
+        pct = lambda vals, p: float(
+            np.sort(np.asarray(vals))[
+                min(len(vals) - 1, int(p / 100 * len(vals)))
+            ]
+        )
+        class_latency = {
+            cls: {"count": len(vals), "p50_s": pct(vals, 50), "p99_s": pct(vals, 99)}
+            for cls, vals in sorted(per_class.items())
+        }
+
+        events = replica_events(survivor)
+        breaks = [e for e in events if e.get("event") == "lease_broken"]
+        reclaims = [
+            e
+            for e in events
+            if e.get("event") == "lease_claimed"
+            and breaks
+            and e.get("t", 0) > breaks[0]["t"]
+        ]
+        resumed = [
+            e
+            for e in events
+            if e.get("event") == "continuation_resumed"
+            and e.get("steps", 0) > 0
+        ]
+        all_events = events + replica_events(victim)
+        return {
+            "requests": n_req,
+            "replicas": 2,
+            "proxies": 1,
+            "victim": victim,
+            "counts": counts,
+            "leases_broken": len(breaks),
+            "preemptions": sum(
+                1 for e in all_events if e.get("event") == "request_preempted"
+            ),
+            "continuations_persisted": sum(
+                1
+                for e in all_events
+                if e.get("event") == "continuation_persisted"
+            ),
+            "resumed_mid_flight": len(resumed),
+            "lease_break_to_reclaim_s": (
+                round(reclaims[0]["t"] - breaks[0]["t"], 3)
+                if breaks and reclaims
+                else None
+            ),
+            "class_latency": class_latency,
+            "wall_s": round(time.perf_counter() - t_start, 1),
+            "zero_lost": counts
+            == {"queued": 0, "running": 0, "done": n_req, "failed": 0},
+            "reclaimed_with_state": bool(breaks) and bool(resumed),
+        }
+    finally:
+        for p in procs.values():
+            if p.poll() is None:
+                p.kill()
+        for log in logs.values():
+            log.close()
+
+
 def bench_serve(nx=129, ny=129, ra=1e7, dt=2e-3, steps_per_req=8):
     """serve129: the simulation-service soak (rustpde_mpi_tpu/serve/).
 
@@ -1107,6 +1301,17 @@ def bench_serve(nx=129, ny=129, ra=1e7, dt=2e-3, steps_per_req=8):
         finally:
             shutil.rmtree(mp_dir, ignore_errors=True)
 
+        # fleet leg (serve/fleet/): proxy + 2 leased replicas, replica
+        # SIGKILL mid-campaign — lease-break/reclaim + per-class latency
+        # + zero-lost/resumed-with-state, recorded like the mp leg
+        fleet_dir = tempfile.mkdtemp(prefix="bench_serve_fleet_")
+        try:
+            fleet = _serve_fleet_leg(fleet_dir)
+        except Exception as exc:  # noqa: BLE001 — recorded, not fatal
+            fleet = {"error": f"{type(exc).__name__}: {exc}"}
+        finally:
+            shutil.rmtree(fleet_dir, ignore_errors=True)
+
         # observability attribution (ISSUE 13): the service-root
         # metrics.jsonl (root's force-dump at server stop) carries the
         # admission-to-first-observable histogram and the per-bucket MFU /
@@ -1207,6 +1412,9 @@ def bench_serve(nx=129, ny=129, ra=1e7, dt=2e-3, steps_per_req=8):
             "isolation_max_rel_diff": max(iso_diffs) if iso_diffs else None,
             "phase_wall_s": [round(wall1, 1), round(wall2, 1)],
             "multiprocess": mp,
+            # the HA fleet payload (replicas spawned, leases broken,
+            # preemptions, break->reclaim latency, per-class percentiles)
+            "fleet": fleet,
             # mp gates are ENFORCED when the 2-proc leg actually ran; a
             # recorded spawn failure ("error" in mp — e.g. a timeout on a
             # loaded box) degrades to the single-process gates alone, with
@@ -1228,6 +1436,16 @@ def bench_serve(nx=129, ny=129, ra=1e7, dt=2e-3, steps_per_req=8):
                 "mp_sanitizer_clean": (
                     None if "error" in mp else bool(mp.get("sanitizer_clean"))
                 ),
+                # fleet gates: None when the leg never ran (error recorded
+                # in the fleet payload), red False only from a leg that RAN
+                "fleet_zero_lost": (
+                    None if "error" in fleet else bool(fleet.get("zero_lost"))
+                ),
+                "fleet_reclaimed_with_state": (
+                    None
+                    if "error" in fleet
+                    else bool(fleet.get("reclaimed_with_state"))
+                ),
             },
             "finite": all(gates.values())
             and (
@@ -1236,6 +1454,12 @@ def bench_serve(nx=129, ny=129, ra=1e7, dt=2e-3, steps_per_req=8):
                     mp.get("zero_lost")
                     and mp.get("drained_then_replanned")
                     and mp.get("sanitizer_clean")
+                )
+            )
+            and (
+                "error" in fleet
+                or bool(
+                    fleet.get("zero_lost") and fleet.get("reclaimed_with_state")
                 )
             ),
         }

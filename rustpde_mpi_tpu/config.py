@@ -149,6 +149,18 @@ register_knob("RUSTPDE_FAULT", None,
 register_knob("RUSTPDE_SHARD_CRASH", None,
               "two-phase commit window kill <after_shard|before_manifest>@<step>[:host<p>]")
 register_knob("RUSTPDE_SPIKE_FACTOR", None, "spike fault velocity scale override")
+# fleet layer (serve/fleet/: replicated front door + queue-level leases)
+register_knob("RUSTPDE_LEASE_TTL_S", "15",
+              "bucket-lease heartbeat TTL: a replica silent past this is "
+              "broken by survivors and its requests re-claimed")
+register_knob("RUSTPDE_FLEET_REPLICA_ID", None,
+              "stable replica identity for lease/heartbeat files "
+              "(unset = <hostname>-<pid>)")
+register_knob("RUSTPDE_FLEET_HEARTBEAT_S", None,
+              "lease/replica heartbeat cadence (unset = lease_ttl/3)")
+register_knob("RUSTPDE_FLEET_QUOTA", None,
+              "default per-tenant admission quota (queued+running; "
+              "unset = unlimited)")
 # collective-sequence sanitizer (parallel/sanitizer.py)
 register_knob("RUSTPDE_SANITIZE", "0",
               "1 = record every multihost collective + cadenced cross-host "
@@ -175,6 +187,8 @@ register_knob("RUSTPDE_SERVE_BENCH_REQUESTS", None,
               "serve129 soak request count", "bench")
 register_knob("RUSTPDE_SERVE_MP_REQUESTS", "4",
               "serve129 2-proc leg request count", "bench")
+register_knob("RUSTPDE_FLEET_BENCH_REQUESTS", "10",
+              "serve129 fleet leg request count (proxy + 2 replicas)", "bench")
 # test harness (tests/ — raw reads allowed, names registered)
 register_knob("RUSTPDE_SLOW", None, "1 = run the slow test tier", "test")
 register_knob("RUSTPDE_TEST_BUDGET_S", "45", "per-test wall budget (fast tier)", "test")
@@ -444,6 +458,75 @@ class ResilienceConfig:
 
 
 @dataclass
+class FleetConfig:
+    """Knobs for the fleet layer (serve/fleet/): N stateless proxy
+    processes and M ``SimServer`` replicas over ONE shared durable queue,
+    coordinated by queue-level lease files — no consensus service, the
+    fsynced atomic-rename lifecycle is the substrate.
+
+    * ``replica_id`` — stable identity for lease/heartbeat files (empty:
+      ``RUSTPDE_FLEET_REPLICA_ID`` env, else ``<hostname>-<pid>``),
+    * ``lease_ttl_s`` — a lease whose heartbeat has not advanced for this
+      long (observer-monotonic, clock-skew tolerant) is STALE: survivors
+      break it and re-claim its requests (None: ``RUSTPDE_LEASE_TTL_S``,
+      default 15),
+    * ``heartbeat_s`` — lease + replica-status heartbeat cadence (None:
+      ``RUSTPDE_FLEET_HEARTBEAT_S``, else ``lease_ttl_s / 3``),
+    * ``default_quota`` — per-tenant admission bound over queued+running
+      requests (None: ``RUSTPDE_FLEET_QUOTA``, unset = unlimited); the
+      429 carries ``Retry-After`` + the live queue depth,
+    * ``quotas`` — per-tenant overrides of ``default_quota``,
+    * ``preempt`` — let an at-risk deadline request park a running
+      best-effort lane (requeue-with-state through the durable
+      continuation dir, loss-free),
+    * ``preempt_slack_s`` — remaining deadline slack below which a queued
+      interactive request is AT RISK and triggers preemption,
+    * ``durable_park`` — persist parked member states into
+      ``parked/<id>/`` continuation dirs (two-phase: state shard +
+      manifest commit marker) so requeue-with-state survives replica
+      SIGKILL.  Off only for A/B debugging — fleet HA rides on it."""
+
+    replica_id: str = ""
+    lease_ttl_s: float | None = None
+    heartbeat_s: float | None = None
+    default_quota: int | None = None
+    quotas: dict = field(default_factory=dict)
+    preempt: bool = True
+    preempt_slack_s: float = 30.0
+    durable_park: bool = True
+
+    def resolved_replica_id(self) -> str:
+        if self.replica_id:
+            return str(self.replica_id)
+        rid = env_get("RUSTPDE_FLEET_REPLICA_ID")
+        if rid:
+            return rid
+        import socket
+
+        return f"{socket.gethostname()}-{os.getpid()}"
+
+    def resolved_ttl(self) -> float:
+        if self.lease_ttl_s is not None:
+            return float(self.lease_ttl_s)
+        return float(env_get("RUSTPDE_LEASE_TTL_S", "15"))
+
+    def resolved_heartbeat(self) -> float:
+        if self.heartbeat_s is not None:
+            return float(self.heartbeat_s)
+        hb = env_get("RUSTPDE_FLEET_HEARTBEAT_S")
+        return float(hb) if hb else self.resolved_ttl() / 3.0
+
+    def resolved_quota(self, tenant: str) -> int | None:
+        if tenant in self.quotas:
+            q = self.quotas[tenant]
+            return None if q is None else int(q)
+        if self.default_quota is not None:
+            return int(self.default_quota)
+        q = env_get("RUSTPDE_FLEET_QUOTA")
+        return int(q) if q else None
+
+
+@dataclass
 class ServeConfig:
     """Knobs for the fault-isolated simulation service
     (:class:`~rustpde_mpi_tpu.serve.SimServer`): a persistent driver that
@@ -524,6 +607,14 @@ class ServeConfig:
     # dt is part of the request contract and the bucket key, so the only
     # legal dt response is re-bucketing, never an in-place set_dt.
     stability: StabilityConfig | None = None
+    # fleet mode (None = off, the single-replica behavior unchanged —
+    # zero extra journal rows or collectives): this SimServer becomes one
+    # replica of a fleet over the shared run_dir — it claims buckets via
+    # queue-level leases, heartbeats them, persists parked continuations
+    # durably, writes its journal/campaigns under replicas/<id>/, and
+    # enforces the QoS traffic contract (quotas, priority classes,
+    # deadlines, preemption).  Pair with serve/fleet/proxy.py fronts.
+    fleet: FleetConfig | None = None
 
 
 @dataclass

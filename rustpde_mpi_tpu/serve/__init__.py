@@ -11,9 +11,20 @@ failures multi-tenancy produces (see serve/scheduler.py for the design):
 * :class:`RequestFailed` / :class:`AdmissionError` / :class:`RequestError`
   — the typed failure surface (terminal divergence, bounded-queue
   backpressure, malformed work),
-* :class:`HttpFront` — optional thin stdlib HTTP front.
+* :class:`HttpFront` — optional thin stdlib HTTP front,
+* :mod:`~rustpde_mpi_tpu.serve.fleet` — the HA fleet layer: stateless
+  :class:`FleetProxy` front doors over the shared queue, queue-level
+  bucket leases with fencing (:class:`LeaseManager` / :class:`LeaseLost`),
+  durable parked continuations, and the QoS traffic contract
+  (tenants / priority classes / deadlines / preemption).
 """
 
+from .fleet import (  # noqa: F401
+    FleetProxy,
+    Lease,
+    LeaseLost,
+    LeaseManager,
+)
 from .http_front import HttpFront  # noqa: F401
 from .queue import DurableQueue  # noqa: F401
 from .request import (  # noqa: F401

@@ -1,0 +1,319 @@
+"""Stateless HTTP proxy tier: the fleet's replicated front door.
+
+PR 10 left HTTP ingestion root-only — one process, one crash, no door.
+A :class:`FleetProxy` is a front-door process that holds NO request
+state: every endpoint reads or writes the shared durable queue (the
+fsynced atomic-rename lifecycle IS the coordination substrate) plus the
+replica heartbeat files, so any number of proxies can run behind a dumb
+TCP load-balancer and any single process death loses nothing::
+
+    POST /requests        validate + QoS quota check + fsynced enqueue
+                          -> 202 {"id","steps","trace_id"}; 429 + a
+                          Retry-After header + queue depth on rejection
+                          (queue_full / quota), 400 malformed, 413 big
+    GET  /requests/<id>   lifecycle record from durable state (404)
+    GET  /stats           queue counts + per-tenant census + bucket
+                          leases + replica heartbeat aggregation
+    GET  /healthz         {"ok", "proxy", "queue", "replicas"} — a
+                          proxy is healthy whenever the queue dir is;
+                          replica liveness rides along for orchestrators
+    GET  /metrics         Prometheus exposition of this proxy's registry
+
+A submit is acknowledged only after the queue fsynced the request file —
+the same durability contract the root front makes — and the ack is valid
+even if every replica is momentarily dead: a replica that comes back (or
+a survivor that breaks the dead one's leases) finds the request in the
+shared queue.
+
+**Replica heartbeats** (how stateless fronts answer "who is serving"):
+each fleet-mode replica atomically rewrites
+``<run_dir>/replicas/<id>.json`` every heartbeat with its stats
+snapshot; :func:`read_replica_status` aggregates them with a staleness
+verdict, and the proxy serves the aggregate on /stats and /healthz.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from ...telemetry import metrics as _tm
+from ...telemetry.exporters import PROMETHEUS_CONTENT_TYPE, prometheus_text
+from ...utils.fsutil import atomic_write_text
+from ...utils.journal import JournalWriter
+from ..http_front import read_body, rejection_payload, reply_json, reply_text
+from ..queue import DurableQueue
+from ..request import AdmissionError, RequestError, SimRequest
+from . import qos as _qos
+from .lease import LeaseManager
+
+
+def replicas_dir(run_dir: str) -> str:
+    return os.path.join(run_dir, "replicas")
+
+
+def write_replica_heartbeat(run_dir: str, replica_id: str, payload: dict) -> None:
+    """Atomically publish one replica's liveness + stats snapshot (tmp +
+    rename + dirsync, like every durable write): proxies aggregate these
+    files, so the write must never be observable half-done."""
+    root = replicas_dir(run_dir)
+    os.makedirs(root, exist_ok=True)
+    record = {
+        "replica": replica_id,
+        "hb_unix": time.time(),
+        "hb_mono": time.monotonic(),
+        "pid": os.getpid(),
+        **payload,
+    }
+    atomic_write_text(
+        os.path.join(root, f"{replica_id}.json"),
+        json.dumps(record, sort_keys=True),
+    )
+
+
+def read_replica_status(run_dir: str, ttl_s: float) -> list[dict]:
+    """Every replica's last heartbeat, staleness-marked: ``stale`` is true
+    when the heartbeat file has not been rewritten for ``ttl_s`` (file
+    mtime vs this process's clock — display-grade; the authoritative
+    failure detector is the lease sweep's observer-monotonic window)."""
+    root = replicas_dir(run_dir)
+    out = []
+    try:
+        names = sorted(os.listdir(root))
+    except OSError:
+        return out
+    now = time.time()
+    for name in names:
+        if not name.endswith(".json"):
+            continue
+        path = os.path.join(root, name)
+        try:
+            age = now - os.stat(path).st_mtime
+            with open(path, encoding="utf-8") as fh:
+                rec = json.load(fh)
+        except (OSError, ValueError):
+            continue
+        rec["hb_age_s"] = round(age, 3)
+        rec["stale"] = age > float(ttl_s)
+        out.append(rec)
+    return out
+
+
+class FleetProxy:
+    """One stateless front-door process over a shared fleet ``run_dir``.
+
+    ``fleet`` (a :class:`~rustpde_mpi_tpu.config.FleetConfig`) supplies
+    the QoS quotas and the staleness TTL for replica reporting; ``None``
+    serves without quotas (pure pass-through admission).  ``start()``
+    binds (port 0 = ephemeral, see ``address``), ``stop()`` shuts down.
+    Thread-safe by construction: handlers touch only the (locked) queue
+    object and read-only durable state."""
+
+    def __init__(
+        self,
+        run_dir: str,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        max_queue: int = 256,
+        fleet=None,
+        registry=None,
+    ):
+        self.run_dir = run_dir
+        self.fleet = fleet
+        self.queue = DurableQueue(
+            os.path.join(run_dir, "queue"), max_queue=int(max_queue)
+        )
+        pid = (
+            fleet.resolved_replica_id()
+            if fleet is not None
+            else f"{os.getpid()}"
+        )
+        self.proxy_id = f"proxy-{pid}"
+        self.ttl_s = fleet.resolved_ttl() if fleet is not None else 15.0
+        self.registry = registry if registry is not None else _tm.default_registry()
+        self._journal_writer = JournalWriter(
+            os.path.join(replicas_dir(run_dir), self.proxy_id, "journal.jsonl")
+        )
+        self._leases = LeaseManager(
+            os.path.join(run_dir, "queue", "leases"), self.proxy_id, self.ttl_s
+        )
+        self._httpd = ThreadingHTTPServer((host, port), self._make_handler())
+        self._httpd.daemon_threads = True
+        self._thread: threading.Thread | None = None
+
+    @property
+    def address(self) -> tuple[str, int]:
+        return self._httpd.server_address[:2]
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="fleet-proxy", daemon=True
+        )
+        self._thread.start()
+        self._journal(
+            {"event": "proxy_listen", "address": list(self.address)}
+        )
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=10.0)
+            self._thread = None
+        self._journal_writer.close()
+
+    def _journal(self, event: dict) -> None:
+        self._journal_writer.append({"proxy": self.proxy_id, **event})
+
+    # -- the admission path (shared by every proxy endpoint handler) ----------
+
+    def submit(self, data: dict) -> SimRequest:
+        """Validate + QoS-admit + durably enqueue one request.  The proxy
+        NEVER talks to a replica: the fsynced queue file is the handoff.
+        Raises RequestError (malformed) / AdmissionError (backpressure or
+        quota)."""
+        if not isinstance(data, dict):
+            raise RequestError(
+                f"request must be a JSON object, got {type(data).__name__}"
+            )
+        req = SimRequest.from_dict(data)
+        req.validate()
+        if self.fleet is not None:
+            # stale cache is fine for a QUOTA (it only over/under-counts
+            # by the race window), but refresh so peer-proxy submits count
+            self.queue.invalidate()
+            try:
+                _qos.check_quota(req, self.queue.tenant_counts(), self.fleet)
+            except AdmissionError as exc:
+                _tm.counter(
+                    "fleet_quota_rejected_total",
+                    "submits rejected by per-tenant quota",
+                    tenant=req.tenant,
+                ).inc()
+                self._journal(
+                    {
+                        "event": "quota_rejected",
+                        "id": req.id,
+                        "tenant": req.tenant,
+                        "reason": exc.reason,
+                    }
+                )
+                raise
+        self.queue.submit(req)
+        _tm.counter(
+            "fleet_proxy_admitted_total", "requests admitted via this proxy"
+        ).inc()
+        self._journal(
+            {
+                "event": "request_admitted",
+                "id": req.id,
+                "trace_id": req.trace_id,
+                "tenant": req.tenant,
+                "priority": req.priority,
+                "key": list(req.compat_key),
+                "via": "proxy",
+            }
+        )
+        return req
+
+    def stats(self) -> dict:
+        self.queue.invalidate()  # other processes write the shared dir
+        return {
+            "proxy": self.proxy_id,
+            "queue": self.queue.counts(),
+            "tenants": self.queue.tenant_counts(),
+            "leases": self._leases.holders(),
+            "replicas": read_replica_status(self.run_dir, 2.0 * self.ttl_s),
+        }
+
+    def _make_handler(self):
+        proxy = self
+
+        class Handler(BaseHTTPRequestHandler):
+            timeout = 30.0
+
+            def log_message(self, fmt, *args):  # journal is the log
+                pass
+
+            def do_GET(self):
+                proxy.registry.counter(
+                    "fleet_proxy_requests_total",
+                    "HTTP requests served by the proxy tier",
+                    method="GET",
+                ).inc()
+                if self.path == "/healthz":
+                    replicas = read_replica_status(
+                        proxy.run_dir, 2.0 * proxy.ttl_s
+                    )
+                    return reply_json(
+                        self,
+                        200,
+                        {
+                            "ok": True,
+                            "proxy": proxy.proxy_id,
+                            "queue": proxy.queue.counts(),
+                            "replicas_alive": sum(
+                                1 for r in replicas if not r["stale"]
+                            ),
+                            "replicas": replicas,
+                        },
+                    )
+                if self.path == "/metrics":
+                    return reply_text(
+                        self,
+                        200,
+                        prometheus_text(proxy.registry),
+                        PROMETHEUS_CONTENT_TYPE,
+                    )
+                if self.path == "/stats":
+                    return reply_json(self, 200, proxy.stats())
+                if self.path.startswith("/requests/"):
+                    rid = self.path.strip("/").split("/")[-1]
+                    proxy.queue.invalidate()  # replicas mutate behind us
+                    found = proxy.queue.lookup(rid)
+                    if found is None:
+                        return reply_json(
+                            self, 404, {"error": "unknown request id"}
+                        )
+                    state, record = found
+                    return reply_json(
+                        self, 200, {"id": rid, "state": state, **record}
+                    )
+                return reply_json(self, 404, {"error": "unknown endpoint"})
+
+            def do_POST(self):
+                proxy.registry.counter(
+                    "fleet_proxy_requests_total",
+                    "HTTP requests served by the proxy tier",
+                    method="POST",
+                ).inc()
+                if self.path != "/requests":
+                    return reply_json(self, 404, {"error": "unknown endpoint"})
+                body, err = read_body(self)
+                if err is not None:
+                    code, message = err
+                    return reply_json(self, code, {"error": message})
+                try:
+                    req = proxy.submit(json.loads(body or b"{}"))
+                except AdmissionError as exc:
+                    proxy.queue.invalidate()
+                    payload, headers = rejection_payload(
+                        exc, proxy.queue.counts()["queued"]
+                    )
+                    return reply_json(self, 429, payload, headers)
+                except (RequestError, ValueError, TypeError) as exc:
+                    return reply_json(self, 400, {"error": str(exc)})
+                return reply_json(
+                    self,
+                    202,
+                    {
+                        "id": req.id,
+                        "steps": req.steps,
+                        "trace_id": req.trace_id,
+                    },
+                )
+
+        return Handler

@@ -1,0 +1,113 @@
+"""The fleet traffic contract: tenants, priority classes, deadlines.
+
+Pure host-side policy — no device work, no collectives, no file writes.
+The scheduler calls these helpers from inside its root-plan closures (the
+decisions are broadcast like every other scheduling verdict) and the
+stateless proxy calls them at admission; both journal the outcomes
+themselves.
+
+Three levers:
+
+* **per-tenant quotas** — :func:`check_quota` bounds one tenant's
+  queued+running footprint; past it the submit is rejected with the typed
+  ``reason="quota"`` :class:`~rustpde_mpi_tpu.serve.AdmissionError`
+  (HTTP: 429 + ``Retry-After`` + the live queue depth), so one noisy
+  tenant degrades into clean backpressure instead of starving the fleet,
+* **priority-ordered scheduling** — :func:`bucket_order` replaces the
+  single-replica FIFO/round-robin bucket pick: buckets sort by the best
+  priority class waiting in them, then by the tightest deadline slack,
+  then by arrival.  Within a bucket the queue's ``claim(qos=True)``
+  applies the same order to individual requests,
+* **deadline-driven preemption** — :func:`find_at_risk` flags the queued
+  interactive request whose remaining slack dropped below the configured
+  threshold; :func:`preempt_victims` picks the running best-effort lanes
+  to park for it (requeue-WITH-state through the durable continuation
+  machinery, so preemption is loss-free).  Only strictly-lower classes
+  are ever victims: interactive preempts best-effort, batch preempts
+  nothing and is preempted by nothing.
+"""
+
+from __future__ import annotations
+
+import time
+
+from ..request import AdmissionError, SimRequest
+
+
+def check_quota(req: SimRequest, tenant_counts: dict, fleet_cfg) -> None:
+    """Raise the typed quota rejection when ``req``'s tenant is at its
+    bound (``tenant_counts`` is the queue's queued+running census)."""
+    quota = fleet_cfg.resolved_quota(req.tenant)
+    if quota is None:
+        return
+    held = int(tenant_counts.get(req.tenant, 0))
+    if held >= quota:
+        raise AdmissionError(
+            "quota",
+            f"tenant {req.tenant!r} holds {held}/{quota} queued+running "
+            "requests; retry after some resolve",
+            retry_after_s=2.0,
+        )
+
+
+def bucket_order(loaded: list, now: float | None = None) -> list[tuple]:
+    """Distinct bucket keys ordered by the QoS contract: best waiting
+    priority class first, tightest deadline slack second, oldest arrival
+    third.  ``loaded`` is the queue's ``(name, SimRequest)`` scan (names
+    sort by enqueue time by construction)."""
+    now = time.time() if now is None else now
+    best: dict[tuple, list] = {}
+    for name, req in loaded:
+        cand = [req.class_rank, req.deadline_slack(now), name]
+        cur = best.get(req.compat_key)
+        if cur is None or cand < cur:
+            best[req.compat_key] = cand
+    return [k for k, _ in sorted(best.items(), key=lambda kv: kv[1])]
+
+
+def find_at_risk(
+    loaded: list, slack_s: float, now: float | None = None
+) -> SimRequest | None:
+    """The most urgent queued deadline-carrying request whose remaining
+    slack is below ``slack_s`` — the preemption trigger.  None when every
+    deadline still has room (the common case: preemption stays idle)."""
+    now = time.time() if now is None else now
+    at_risk = [
+        req
+        for _, req in loaded
+        if req.deadline_s is not None and req.deadline_slack(now) < slack_s
+    ]
+    if not at_risk:
+        return None
+    return min(at_risk, key=lambda r: (r.class_rank, r.deadline_slack(now)))
+
+
+def preempt_victims(
+    running: list, at_risk: SimRequest, current_key: tuple
+) -> list[int]:
+    """Slot indices to park for ``at_risk``: only lanes running a STRICTLY
+    lower class are candidates (best-effort under an interactive emergency
+    — batch is never preempted).  Same-bucket emergencies free exactly one
+    lane (the at-risk request refills it this boundary); cross-bucket ones
+    park every candidate lane, so the campaign drains toward its end and
+    the priority-ordered bucket pick takes the urgent bucket next.
+    ``running`` is ``[(slot_index, SimRequest), ...]``."""
+    if at_risk.class_rank > 0:
+        # only the interactive class may preempt: a late BATCH deadline
+        # is a scheduling miss, not an emergency worth evicting for
+        return []
+    now = time.time()
+    victims = sorted(
+        (
+            (req.class_rank, req.deadline_slack(now), i)
+            for i, req in running
+            if req.class_rank > at_risk.class_rank
+            and req.class_rank >= 2  # only the best-effort lane is fair game
+        ),
+        reverse=True,  # worst class first, then MOST slack (the lane best
+    )  # able to absorb a park) — never the one nearest its own deadline
+    if not victims:
+        return []
+    if tuple(at_risk.compat_key) == tuple(current_key):
+        return [victims[0][2]]
+    return [v[2] for v in victims]

@@ -7,7 +7,10 @@ thread-safe surface::
     POST /requests        {"ra":1e4,"horizon":0.1,...}  -> 202 {"id", "steps",
                           "trace_id"} — the trace id names the request's
                           whole lifecycle across restarts
-                          429 {"error","reason"} on admission rejection
+                          429 {"error","reason","queue_depth",
+                          "retry_after_s"} + a Retry-After header on
+                          admission rejection (queue_full / draining /
+                          quota), so clients back off intelligently
                           400 on a malformed request body / bad
                           Content-Length / truncated body, 413 oversized
     GET  /requests/<id>   lifecycle record               (404 unknown)
@@ -45,6 +48,71 @@ from .request import AdmissionError, RequestError
 #: request bodies past this are rejected with 413 before any parse — a
 #: SimRequest is a handful of scalars; megabyte bodies are abuse or bugs
 MAX_BODY_BYTES = 1 << 20
+
+
+def reply_json(handler, code: int, payload: dict, headers: dict | None = None) -> None:
+    """One JSON reply, shared by every front (the root server's handler
+    and the fleet proxy's): Content-Length framed, optional extra headers
+    (the 429 path's ``Retry-After``)."""
+    body = json.dumps(payload).encode("utf-8")
+    handler.send_response(code)
+    handler.send_header("Content-Type", "application/json")
+    handler.send_header("Content-Length", str(len(body)))
+    for name, value in (headers or {}).items():
+        handler.send_header(name, str(value))
+    handler.end_headers()
+    handler.wfile.write(body)
+
+
+def reply_text(handler, code: int, text: str, content_type: str) -> None:
+    body = text.encode("utf-8")
+    handler.send_response(code)
+    handler.send_header("Content-Type", content_type)
+    handler.send_header("Content-Length", str(len(body)))
+    handler.end_headers()
+    handler.wfile.write(body)
+
+
+def read_body(handler):
+    """Validated request body, or (code, error) on a broken frame:
+    non-integer/negative Content-Length -> 400, oversized -> 413,
+    truncated (client hung up early) -> 400.  Never trusts the header for
+    the read — the socket read is capped and the byte count re-checked."""
+    raw = handler.headers.get("Content-Length", "0")
+    try:
+        length = int(raw)
+    except (TypeError, ValueError):
+        return None, (400, f"bad Content-Length: {raw!r}")
+    if length < 0:
+        return None, (400, f"bad Content-Length: {raw!r}")
+    if length > MAX_BODY_BYTES:
+        return None, (
+            413,
+            f"request body of {length} bytes exceeds the "
+            f"{MAX_BODY_BYTES}-byte limit",
+        )
+    body = handler.rfile.read(length)
+    if len(body) != length:
+        return None, (
+            400,
+            f"truncated body: Content-Length {length}, got {len(body)} bytes",
+        )
+    return body, None
+
+
+def rejection_payload(exc: AdmissionError, queue_depth: int):
+    """The 429 body + headers for one admission rejection: machine-
+    readable reason, the live queue depth, and a ``Retry-After`` both in
+    the JSON and as the standard header — so clients can back off
+    intelligently instead of hammering a full queue."""
+    retry_after = max(1, int(round(exc.retry_after_s)))
+    payload = {
+        "error": str(exc),
+        "reason": exc.reason,
+        "queue_depth": int(queue_depth),
+        "retry_after_s": retry_after,
+    }
+    return payload, {"Retry-After": retry_after}
 
 
 class HttpFront:
@@ -92,21 +160,11 @@ class HttpFront:
             def log_message(self, fmt, *args):  # quiet: the journal is the log
                 pass
 
-            def _reply(self, code: int, payload: dict) -> None:
-                body = json.dumps(payload).encode("utf-8")
-                self.send_response(code)
-                self.send_header("Content-Type", "application/json")
-                self.send_header("Content-Length", str(len(body)))
-                self.end_headers()
-                self.wfile.write(body)
+            def _reply(self, code: int, payload: dict, headers: dict | None = None) -> None:
+                reply_json(self, code, payload, headers)
 
             def _reply_text(self, code: int, text: str, content_type: str) -> None:
-                body = text.encode("utf-8")
-                self.send_response(code)
-                self.send_header("Content-Type", content_type)
-                self.send_header("Content-Length", str(len(body)))
-                self.end_headers()
-                self.wfile.write(body)
+                reply_text(self, code, text, content_type)
 
             def do_GET(self):
                 registry.counter(
@@ -147,32 +205,7 @@ class HttpFront:
                 return self._reply(404, {"error": "unknown endpoint"})
 
             def _read_body(self):
-                """Validated request body, or (code, error) on a broken
-                frame: non-integer/negative Content-Length -> 400,
-                oversized -> 413, truncated (client hung up early) -> 400.
-                Never trusts the header for the read — the socket read is
-                capped and the byte count re-checked."""
-                raw = self.headers.get("Content-Length", "0")
-                try:
-                    length = int(raw)
-                except (TypeError, ValueError):
-                    return None, (400, f"bad Content-Length: {raw!r}")
-                if length < 0:
-                    return None, (400, f"bad Content-Length: {raw!r}")
-                if length > MAX_BODY_BYTES:
-                    return None, (
-                        413,
-                        f"request body of {length} bytes exceeds the "
-                        f"{MAX_BODY_BYTES}-byte limit",
-                    )
-                body = self.rfile.read(length)
-                if len(body) != length:
-                    return None, (
-                        400,
-                        f"truncated body: Content-Length {length}, "
-                        f"got {len(body)} bytes",
-                    )
-                return body, None
+                return read_body(self)
 
             def do_POST(self):
                 registry.counter(
@@ -207,9 +240,13 @@ class HttpFront:
                     data = json.loads(body or b"{}")
                     req = sim.submit(data)
                 except AdmissionError as exc:
-                    return self._reply(
-                        429, {"error": str(exc), "reason": exc.reason}
+                    # 429 with a Retry-After header + the live queue depth
+                    # in the body: clients see WHY and for HOW LONG, not a
+                    # bare reason string
+                    payload, headers = rejection_payload(
+                        exc, sim.queue.counts()["queued"]
                     )
+                    return self._reply(429, payload, headers)
                 except (RequestError, ValueError, TypeError) as exc:
                     return self._reply(400, {"error": str(exc)})
                 return self._reply(

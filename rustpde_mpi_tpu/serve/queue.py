@@ -29,31 +29,23 @@ from handler threads while the scheduler claims from the campaign loop).
 
 from __future__ import annotations
 
+import bisect
 import json
 import os
 import threading
 import time
 
 from .request import AdmissionError, RequestError, SimRequest
-from ..utils.fsutil import fsync_dir
+from ..utils.fsutil import atomic_write_text, fsync_dir
 
 _STATES = ("queued", "running", "done", "failed")
 
 
-# one shared durability primitive (utils/fsutil): os.replace alone leaves
+# shared durability primitives (utils/fsutil): os.replace alone leaves
 # the new dirent in page cache — the request-never-lost guarantee would
 # rest on the filesystem journaling renames by luck
 _fsync_dir = fsync_dir
-
-
-def _atomic_write(path: str, text: str) -> None:
-    tmp = f"{path}.{os.getpid()}.tmp"
-    with open(tmp, "w", encoding="utf-8") as fh:
-        fh.write(text)
-        fh.flush()
-        os.fsync(fh.fileno())
-    os.replace(tmp, path)
-    _fsync_dir(os.path.dirname(path) or ".")
+_atomic_write = atomic_write_text
 
 
 class DurableQueue:
@@ -64,6 +56,18 @@ class DurableQueue:
         self.max_queue = int(max_queue)
         self._lock = threading.RLock()
         self._seq = 0  # in-process tiebreak under one time.time_ns() tick
+        # queued-dir scan cache: the scheduler consults the queue several
+        # times per chunk boundary (bucket order, fairness probe, claims)
+        # and each consult was an O(all files) listdir + a JSON parse per
+        # file — a 10k-deep queue taxed every boundary.  The listing is
+        # kept incrementally coherent across own mutations (enqueue
+        # inserts, claim/recover evict, a lost claim race invalidates);
+        # queued files are immutable once placed (a requeue writes a NEW
+        # seq-name), so parsed requests are cached by name too.  External
+        # writers (fleet proxies, peer replicas over the shared dir) are
+        # handled by invalidate() + the claim-race eviction path.
+        self._listing: list[str] | None = None
+        self._req_cache: dict[str, SimRequest] = {}
         for state in _STATES:
             os.makedirs(os.path.join(root, state), exist_ok=True)
 
@@ -83,13 +87,16 @@ class DurableQueue:
         with self._lock:
             if not admit_open:
                 raise AdmissionError(
-                    "draining", "the service is draining and admits no new work"
+                    "draining",
+                    "the service is draining and admits no new work",
+                    retry_after_s=30.0,
                 )
             if len(self._queued_files()) >= self.max_queue:
                 raise AdmissionError(
                     "queue_full",
                     f"{self.max_queue} requests already queued; retry with "
                     "backoff",
+                    retry_after_s=5.0,
                 )
             self._enqueue(req)
         return req
@@ -103,6 +110,9 @@ class DurableQueue:
         self._seq += 1
         name = f"{time.time_ns():020d}{self._seq:04d}-{req.id}.json"
         _atomic_write(os.path.join(self._dir("queued"), name), req.to_json())
+        if self._listing is not None:
+            bisect.insort(self._listing, name)
+        self._req_cache[name] = req
 
     def _state_files(self, state: str) -> list[str]:
         """Committed request files only: a crash inside ``_atomic_write``
@@ -116,23 +126,62 @@ class DurableQueue:
             return []
 
     def _queued_files(self) -> list[str]:
-        return self._state_files("queued")
+        if self._listing is None:
+            self._listing = self._state_files("queued")
+            self._req_cache = {
+                n: r for n, r in self._req_cache.items() if n in set(self._listing)
+            }
+        return self._listing
+
+    def _evict(self, name: str) -> None:
+        """Drop one name from the cached listing (claimed/raced away)."""
+        if self._listing is not None:
+            try:
+                self._listing.remove(name)
+            except ValueError:
+                pass
+        self._req_cache.pop(name, None)
+
+    def invalidate(self) -> None:
+        """Forget the cached queued-dir listing: the next scan re-lists.
+        Fleet replicas call this once per scheduler boundary — proxies and
+        peer replicas write the shared dir behind this process's back."""
+        with self._lock:
+            self._listing = None
 
     # -- scheduling -----------------------------------------------------------
 
     def _load_queued(self) -> list[tuple[str, SimRequest]]:
         out = []
-        for name in self._queued_files():
+        for name in list(self._queued_files()):
+            req = self._req_cache.get(name)
+            if req is not None:
+                out.append((name, req))
+                continue
             path = os.path.join(self._dir("queued"), name)
             try:
                 with open(path, encoding="utf-8") as fh:
-                    out.append((name, SimRequest.from_json(fh.read())))
+                    req = SimRequest.from_json(fh.read())
+            except FileNotFoundError:
+                # a peer replica claimed it between our listdir and this
+                # read (fleet mode: the shared dir has other writers)
+                self._evict(name)
+                continue
             except (OSError, ValueError, RequestError):
                 # unreachable in practice: submit() fsyncs before the
                 # atomic rename and .tmp corpses are filtered out — but a
                 # truly unreadable file must not wedge scheduling forever
                 continue
+            self._req_cache[name] = req
+            out.append((name, req))
         return out
+
+    def snapshot_queued(self) -> list[tuple[str, SimRequest]]:
+        """The queued scan as ``(name, request)`` pairs (names sort by
+        enqueue order) — the fleet QoS planner's input; served from the
+        listing/request caches like every other consult."""
+        with self._lock:
+            return list(self._load_queued())
 
     def buckets(self) -> dict[tuple, int]:
         """Pending request count per compatibility bucket, FIFO-weighted:
@@ -171,19 +220,45 @@ class DurableQueue:
                     return True
         return False
 
-    def claim(self, key: tuple | None = None) -> SimRequest | None:
+    def _claim_name(self, name: str, req: SimRequest) -> bool:
+        """Move one queued file into ``running/``; False when a peer
+        replica raced the claim (the source vanished under us — fleet
+        mode's shared dir), in which case the stale cache is dropped."""
+        src = os.path.join(self._dir("queued"), name)
+        dst = os.path.join(self._dir("running"), f"{req.id}.json")
+        try:
+            os.replace(src, dst)
+        except FileNotFoundError:
+            self._evict(name)
+            return False
+        _fsync_dir(self._dir("running"))
+        _fsync_dir(self._dir("queued"))
+        self._evict(name)
+        return True
+
+    def claim(self, key: tuple | None = None, qos: bool = False) -> SimRequest | None:
         """Atomically move the oldest queued request (matching ``key`` when
-        given) into ``running/`` and return it; None when nothing matches."""
+        given) into ``running/`` and return it; None when nothing matches.
+        ``qos=True`` picks by (priority class, deadline slack, FIFO)
+        instead of pure FIFO — the fleet traffic contract's claim order."""
         with self._lock:
-            for name, req in self._load_queued():
-                if key is not None and req.compat_key != key:
-                    continue
-                src = os.path.join(self._dir("queued"), name)
-                dst = os.path.join(self._dir("running"), f"{req.id}.json")
-                os.replace(src, dst)
-                _fsync_dir(self._dir("running"))
-                _fsync_dir(self._dir("queued"))
-                return req
+            candidates = [
+                (name, req)
+                for name, req in self._load_queued()
+                if key is None or req.compat_key == key
+            ]
+            if qos:
+                now = time.time()
+                candidates.sort(
+                    key=lambda nr: (
+                        nr[1].class_rank,
+                        nr[1].deadline_slack(now),
+                        nr[0],
+                    )
+                )
+            for name, req in candidates:
+                if self._claim_name(name, req):
+                    return req
         return None
 
     def claim_id(self, request_id: str) -> SimRequest | None:
@@ -195,12 +270,8 @@ class DurableQueue:
             for name, req in self._load_queued():
                 if req.id != request_id:
                     continue
-                src = os.path.join(self._dir("queued"), name)
-                dst = os.path.join(self._dir("running"), f"{req.id}.json")
-                os.replace(src, dst)
-                _fsync_dir(self._dir("running"))
-                _fsync_dir(self._dir("queued"))
-                return req
+                if self._claim_name(name, req):
+                    return req
         return None
 
     # -- resolution -----------------------------------------------------------
@@ -263,6 +334,50 @@ class DurableQueue:
             if recovered:
                 _fsync_dir(self._dir("running"))
         return recovered
+
+    def recover_bucket(self, key: tuple) -> list[str]:
+        """Re-enqueue the ``running/`` requests of ONE compat bucket — the
+        fleet lease-break path: a dead replica's claims are scoped by the
+        bucket lease the survivor just broke, never the whole running dir
+        (peer replicas' live claims must not be stolen).  Returns the
+        recovered ids."""
+        recovered = []
+        with self._lock:
+            for name in self._state_files("running"):
+                path = os.path.join(self._dir("running"), name)
+                try:
+                    with open(path, encoding="utf-8") as fh:
+                        req = SimRequest.from_json(fh.read())
+                except (OSError, ValueError, RequestError):
+                    continue
+                if req.compat_key != key:
+                    continue
+                self._enqueue(req)
+                try:
+                    os.remove(path)
+                except OSError:
+                    pass  # queued copy wins either way: duplicate beats lost
+                recovered.append(req.id)
+            if recovered:
+                _fsync_dir(self._dir("running"))
+        return recovered
+
+    def tenant_counts(self) -> dict[str, int]:
+        """Waiting + in-flight request count per tenant — the QoS quota
+        denominator (done/failed are resolved: they no longer charge)."""
+        with self._lock:
+            counts: dict[str, int] = {}
+            for _, req in self._load_queued():
+                counts[req.tenant] = counts.get(req.tenant, 0) + 1
+            for name in self._state_files("running"):
+                path = os.path.join(self._dir("running"), name)
+                try:
+                    with open(path, encoding="utf-8") as fh:
+                        req = SimRequest.from_json(fh.read())
+                except (OSError, ValueError, RequestError):
+                    continue
+                counts[req.tenant] = counts.get(req.tenant, 0) + 1
+            return counts
 
     # -- introspection --------------------------------------------------------
 

@@ -36,13 +36,32 @@ class RequestError(ValueError):
 
 class AdmissionError(RuntimeError):
     """The service refused to admit a request — bounded-queue backpressure
-    (``reason="queue_full"``) or a draining/stopped service
-    (``reason="draining"``).  Typed reject-with-reason instead of an
-    unbounded backlog: the client backs off or routes elsewhere."""
+    (``reason="queue_full"``), a draining/stopped service
+    (``reason="draining"``), or a tenant over its QoS quota
+    (``reason="quota"``).  Typed reject-with-reason instead of an
+    unbounded backlog: the client backs off or routes elsewhere.
+    ``retry_after_s`` is the back-off hint the HTTP 429 surfaces as a
+    ``Retry-After`` header."""
 
-    def __init__(self, reason: str, detail: str):
+    def __init__(self, reason: str, detail: str, retry_after_s: float = 5.0):
         super().__init__(f"request rejected ({reason}): {detail}")
         self.reason = reason
+        self.retry_after_s = float(retry_after_s)
+
+
+#: QoS priority classes, best first — the rank orders bucket selection
+#: and decides who may preempt whom (interactive preempts best-effort;
+#: batch neither preempts nor is preempted by batch)
+PRIORITY_CLASSES = ("interactive", "batch", "best-effort")
+
+
+def priority_rank(priority: str) -> int:
+    """0 = most urgent.  Unknown classes sort last (defensive: validation
+    rejects them at admission, but durable files outlive code)."""
+    try:
+        return PRIORITY_CLASSES.index(priority)
+    except ValueError:
+        return len(PRIORITY_CLASSES)
 
 
 class RequestFailed(RuntimeError):
@@ -94,6 +113,15 @@ class SimRequest:
     periodic: bool = False
     model: str = "dns"  # workloads-registry kind
     scenario: dict | None = None  # DNS step modifiers (compat-key signed)
+    # QoS traffic contract (serve/fleet/qos.py): the tenant the quota is
+    # charged to, the priority class (PRIORITY_CLASSES) ordering bucket
+    # selection + preemption, and an optional soft deadline in seconds
+    # from submission — a queued interactive request whose deadline slack
+    # runs low preempts a running best-effort lane.  None of these joins
+    # compat_key: requests of different tenants/classes co-batch freely.
+    tenant: str = "default"
+    priority: str = "batch"
+    deadline_s: float | None = None
     seed: int = 0
     amp: float | None = None  # IC amplitude (None: ServeConfig.default_amp)
     id: str = ""
@@ -137,6 +165,17 @@ class SimRequest:
             raise RequestError(f"horizon must be positive, got {self.horizon}")
         if not (self.ra > 0.0 and self.pr > 0.0):
             raise RequestError(f"Ra/Pr must be positive, got {self.ra}/{self.pr}")
+        if self.priority not in PRIORITY_CLASSES:
+            raise RequestError(
+                f"priority must be one of {PRIORITY_CLASSES}, "
+                f"got {self.priority!r}"
+            )
+        if not self.tenant or not isinstance(self.tenant, str):
+            raise RequestError(f"tenant must be a non-empty string, got {self.tenant!r}")
+        if self.deadline_s is not None and not (float(self.deadline_s) > 0.0):
+            raise RequestError(
+                f"deadline_s must be positive (or null), got {self.deadline_s}"
+            )
         from ..workloads.registry import model_kinds
 
         if self.model not in model_kinds():
@@ -195,6 +234,18 @@ class SimRequest:
     def steps_remaining(self) -> int:
         """Steps still owed after any drained-campaign progress."""
         return max(0, self.steps - int(self.progress))
+
+    @property
+    def class_rank(self) -> int:
+        """QoS priority rank (0 = interactive, most urgent)."""
+        return priority_rank(self.priority)
+
+    def deadline_slack(self, now: float) -> float:
+        """Seconds of deadline slack left at wall time ``now`` (may be
+        negative: already late); +inf for deadline-free requests."""
+        if self.deadline_s is None:
+            return float("inf")
+        return (self.submitted_s + float(self.deadline_s)) - float(now)
 
     def backed_off(self, factor: float) -> "SimRequest":
         """The retry copy: dt shrunk, retry counted, progress DISCARDED —

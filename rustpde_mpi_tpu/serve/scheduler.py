@@ -86,6 +86,7 @@ import hashlib
 import json
 import os
 import signal
+import threading
 import time
 
 import numpy as np
@@ -171,8 +172,54 @@ class SimServer:
         self.queue = DurableQueue(
             os.path.join(self.cfg.run_dir, "queue"), max_queue=self.cfg.max_queue
         )
-        self.journal_path = os.path.join(self.cfg.run_dir, "journal.jsonl")
+        # fleet mode (cfg.fleet): this server is ONE replica of a fleet
+        # sharing run_dir — its journal/campaigns/metrics move under
+        # replicas/<id>/ (the queue + leases + parked continuations stay
+        # shared), buckets are claimed through queue-level leases, and
+        # parked member states persist durably.  fleet=None leaves every
+        # path below byte-identical to the single-replica behavior.
+        self._fleet = self.cfg.fleet
+        self._lease = None  # the ACTIVE campaign's bucket lease (root)
+        self._lease_mgr = None
+        self._fenced = False  # lost our lease mid-campaign (root flag)
+        self._claims_closed = False  # cross-bucket preemption: drain, don't refill
+        self._hb_mark = 0.0
+        self._cont_mark = 0.0  # cadence mark for running-slot continuations
+        # lease liveness must not ride the campaign loop's cadence: a
+        # model build or first-chunk compile stalls boundaries for many
+        # seconds, which would read as replica death and thrash the
+        # fleet with spurious breaks.  Root runs a daemon HEARTBEAT
+        # THREAD instead (pure host-side file IO — never a collective):
+        # process alive == lease renewed, exactly the failure-detector
+        # semantics the sweep wants.  _hb_lock serializes the thread
+        # against the main loop's claim/release/fence transitions.
+        self._hb_lock = threading.Lock()
+        self._hb_stop: threading.Event | None = None
+        self._hb_thread: threading.Thread | None = None
+        self._preempted = 0
+        self._quota_rejected = 0
+        self._leases_broken = 0
+        self._continuations = 0
+        if self._fleet is not None:
+            self._replica_id = self._fleet.resolved_replica_id()
+            self._replica_dir = os.path.join(
+                self.cfg.run_dir, "replicas", self._replica_id
+            )
+            self.journal_path = os.path.join(self._replica_dir, "journal.jsonl")
+        else:
+            self._replica_id = ""
+            self._replica_dir = self.cfg.run_dir
+            self.journal_path = os.path.join(self.cfg.run_dir, "journal.jsonl")
         self._journal_writer = JournalWriter(self.journal_path)
+        if self._fleet is not None:
+            from .fleet.lease import LeaseManager
+
+            self._lease_mgr = LeaseManager(
+                os.path.join(self.cfg.run_dir, "queue", "leases"),
+                self._replica_id,
+                self._fleet.resolved_ttl(),
+                journal=self._journal,
+            )
         self._fault = FaultPlan.from_spec(
             fault if fault is not None else env_get("RUSTPDE_FAULT")
         )
@@ -277,6 +324,34 @@ class SimServer:
             )
         if req.amp is None:
             req.amp = float(self.cfg.default_amp)
+        if self._fleet is not None:
+            # the QoS quota half of the traffic contract: one tenant's
+            # burst degrades into typed 429s before it can starve peers
+            from .fleet import qos as _qos
+
+            try:
+                # refresh first: proxies + peer replicas write the shared
+                # dir behind this process's listing cache, and a stale
+                # census would under-count the tenant (the proxy path
+                # invalidates before its quota check for the same reason)
+                self.queue.invalidate()
+                _qos.check_quota(req, self.queue.tenant_counts(), self._fleet)
+            except AdmissionError as exc:
+                self._quota_rejected += 1
+                _tm.counter(
+                    "serve_admission_rejected_total",
+                    "submits rejected by admission control",
+                    reason=exc.reason,
+                ).inc()
+                self._journal(
+                    {
+                        "event": "quota_rejected",
+                        "id": req.id,
+                        "tenant": req.tenant,
+                        "priority": req.priority,
+                    }
+                )
+                raise
         try:
             self.queue.submit(req, admit_open=not self._drain)
         except AdmissionError as exc:
@@ -398,7 +473,7 @@ class SimServer:
         return self._mesh_cache
 
     def stats(self) -> dict:
-        return {
+        out = {
             "queue": self.queue.counts(),
             "completed": self._completed,
             "failed": self._failed,
@@ -410,6 +485,16 @@ class SimServer:
             "draining": self._drain,
             "slots": self.slot_info(),
         }
+        if self._fleet is not None:
+            out["fleet"] = {
+                "replica": self._replica_id,
+                "lease": self._lease.tag if self._lease else None,
+                "leases_broken": self._leases_broken,
+                "preempted": self._preempted,
+                "quota_rejected": self._quota_rejected,
+                "continuations_persisted": self._continuations,
+            }
+        return out
 
     # -- service loop ---------------------------------------------------------
 
@@ -426,7 +511,14 @@ class SimServer:
         if root:
             self._start_http()
         unclean = self._detect_unclean_shutdown() if root else False
-        recovered = self.queue.recover() if root else []
+        # fleet mode NEVER runs the global running/ recovery: peer
+        # replicas' live claims would be stolen.  Recovery is scoped by
+        # lease instead — the sweep breaks stale leases (our own previous
+        # incarnation's included, once their TTL lapses) and re-enqueues
+        # exactly those buckets' requests.
+        recovered = (
+            self.queue.recover() if root and self._fleet is None else []
+        )
         self._journal(
             {
                 "event": "server_start",
@@ -435,15 +527,18 @@ class SimServer:
                 "processes": self._nproc(),
                 "recovered": recovered,
                 "unclean_shutdown": unclean,
+                "replica": self._replica_id or None,
                 "fault": dataclasses.asdict(self._fault) if self._fault else None,
             }
         )
+        self._fleet_heartbeat(force=True)
+        self._start_heartbeat_thread()
         self._sync("serve-start")
         try:
             while not self._drain_agreed():
                 key = self._next_bucket_agreed()
                 if key is None:
-                    if self.cfg.idle_exit:
+                    if self.cfg.idle_exit and self._idle_done_agreed():
                         break
                     time.sleep(self.cfg.poll_s)
                     continue
@@ -485,10 +580,14 @@ class SimServer:
             self._journal({"event": "server_stop", **summary})
             if root:
                 # service-level metrics flush: one jsonl line at the service
-                # root (campaign runners dump their own under campaigns/<key>)
+                # root (campaign runners dump their own under campaigns/<key>;
+                # fleet replicas dump under replicas/<id>/ so peers sharing
+                # the run_dir never interleave files)
                 MetricsDumper(
-                    os.path.join(self.cfg.run_dir, "metrics.jsonl")
+                    os.path.join(self._replica_dir, "metrics.jsonl")
                 ).dump(step=self._global_step)
+            self._stop_heartbeat_thread()
+            self._fleet_heartbeat(force=True, stopping=True)
             self._journal_writer.close()  # reopens lazily if used again
             self._stop_http()
             if _sys.exc_info()[0] is None:
@@ -507,6 +606,26 @@ class SimServer:
         fleet's next collective."""
         self._drain = self._root_decides(self._drain)
         return self._drain
+
+    def _idle_done_agreed(self) -> bool:
+        """Is an idle-exit (batch mode) really DONE?  Single-replica:
+        yes — an empty bucket scan means an empty queue.  Fleet mode: only
+        once nothing is queued, nothing is running and no bucket lease
+        exists — a peer may still be serving (its lease pins its work),
+        and a DEAD peer's lease needs one observer TTL before the sweep
+        may break it, so a batch replica must keep polling rather than
+        exit under work it will be able to reclaim.  Root decides,
+        broadcast (the queue and the lease dir are root's to read)."""
+        if self._fleet is None:
+            return True
+
+        def decide():
+            counts = self.queue.counts()
+            if counts["queued"] or counts["running"]:
+                return False
+            return not self._lease_mgr.holders()
+
+        return bool(self._root_plan(decide))
 
     def _next_bucket_agreed(self) -> tuple | None:
         """Root picks the bucket (the queue is root's); the key is
@@ -590,7 +709,14 @@ class SimServer:
         pick ROTATES past the previously-served bucket — so under a
         daemon-mode mixed workload a hot bucket whose requests keep
         arriving cannot be re-picked while other buckets wait.  With one
-        bucket (or none after it) this degrades to oldest-first."""
+        bucket (or none after it) this degrades to oldest-first.
+
+        Fleet mode replaces both halves: buckets order by the QoS
+        contract (priority class, then deadline slack, then arrival) and
+        a bucket is only returned once its LEASE is claimed — runs on
+        root (inside the broadcast pick), like the queue scan itself."""
+        if self._fleet is not None:
+            return self._next_bucket_fleet()
         order = self.queue.bucket_order()
         _tm.gauge(
             "serve_bucket_occupancy", "distinct compat buckets with queued work"
@@ -602,9 +728,135 @@ class SimServer:
             return order[(i + 1) % len(order)]
         return order[0]
 
+    def _next_bucket_fleet(self) -> tuple | None:
+        """Fleet bucket pick (root): sweep-break stale peer leases and
+        re-claim their requests, then walk the QoS-ordered buckets and
+        return the first whose lease this replica wins.  A bucket leased
+        to a live peer is skipped — two replicas can never own one bucket
+        (the lease claim is an exclusive dirent creation)."""
+        from ..parallel import multihost
+        from .fleet import qos as _qos
+        from .fleet.lease import bucket_tag
+
+        self._fleet_heartbeat()
+        self.queue.invalidate()  # proxies + peer replicas write behind us
+        for rec in self._lease_mgr.sweep():
+            # the dead holder's claims come back: queued again, scoped to
+            # exactly the broken bucket — live peers' claims are untouched
+            self._leases_broken += 1
+            _tm.counter(
+                "serve_leases_broken_total",
+                "stale peer leases broken by this replica",
+            ).inc()
+            key = rec.get("bucket")
+            if key:
+                key = multihost.tuplify(key)
+                ids = self.queue.recover_bucket(key)
+                self._journal(
+                    {
+                        "event": "requests_reclaimed",
+                        "bucket": bucket_tag(key),
+                        "owner": rec.get("owner"),
+                        "ids": ids,
+                    }
+                )
+        order = _qos.bucket_order(self.queue.snapshot_queued())
+        _tm.gauge(
+            "serve_bucket_occupancy", "distinct compat buckets with queued work"
+        ).set(len(order))
+        for key in order:
+            lease = self._lease_mgr.claim(key)
+            if lease is not None:
+                with self._hb_lock:
+                    self._lease = lease
+                return key
+        return None
+
+    def _fleet_heartbeat(self, force: bool = False, stopping: bool = False) -> None:
+        """Root-only liveness publication: rewrite this replica's
+        heartbeat file (the proxies' /stats source) and renew the held
+        bucket lease.  Cadenced by ``FleetConfig.heartbeat_s``; pure
+        host-side file IO, no collectives (safe anywhere on root).  A
+        renewal that discovers the lease was broken + re-claimed marks
+        this replica FENCED — the boundary fence check abandons the
+        campaign before any further queue write."""
+        if self._fleet is None or not self._is_root():
+            return
+        now = time.monotonic()
+        if not force and (now - self._hb_mark) < self._fleet.resolved_heartbeat():
+            return
+        self._hb_mark = now
+        from .fleet.lease import LeaseLost
+        from .fleet.proxy import write_replica_heartbeat
+
+        try:
+            write_replica_heartbeat(
+                self.cfg.run_dir,
+                self._replica_id,
+                {
+                    "draining": self._drain,
+                    "stopping": bool(stopping),
+                    "slots": list(self._slots_state),
+                    "completed": self._completed,
+                    "failed": self._failed,
+                    "queue": self.queue.counts(),
+                },
+            )
+        except OSError:
+            pass  # heartbeat loss degrades to lease staleness, not a crash
+        with self._hb_lock:
+            lease = self._lease
+            if lease is None:
+                return
+            try:
+                lease.renew()
+            except LeaseLost as exc:
+                self._journal(
+                    {
+                        "event": "lease_fenced",
+                        "bucket": lease.tag,
+                        "detail": str(exc),
+                    }
+                )
+                self._lease = None
+                self._fenced = True
+
+    def _start_heartbeat_thread(self) -> None:
+        """Root-only, fleet-only: renew the lease + replica heartbeat on
+        a daemon thread so liveness never depends on how long a compile
+        or a chunk keeps the main thread busy.  File IO only — the thread
+        must never touch device state or collectives."""
+        if self._fleet is None or not self._is_root():
+            return
+        self._hb_stop = threading.Event()
+
+        def loop():
+            while not self._hb_stop.wait(self._fleet.resolved_heartbeat()):
+                try:
+                    self._fleet_heartbeat(force=True)
+                except Exception:  # noqa: BLE001 — liveness must not crash serve
+                    pass
+
+        self._hb_thread = threading.Thread(
+            target=loop, name="fleet-heartbeat", daemon=True
+        )
+        self._hb_thread.start()
+
+    def _stop_heartbeat_thread(self) -> None:
+        if self._hb_stop is not None:
+            self._hb_stop.set()
+            if self._hb_thread is not None:
+                self._hb_thread.join(timeout=5.0)
+            self._hb_thread = None
+            self._hb_stop = None
+
     def _campaign_dir(self, key: tuple) -> str:
         tag = hashlib.sha1(repr(key).encode()).hexdigest()[:12]
-        return os.path.join(self.cfg.run_dir, "campaigns", tag)
+        # fleet replicas keep campaign checkpoints under their own
+        # replicas/<id>/ subtree: two replicas must never rotate/sweep
+        # each other's checkpoint files (cross-replica continuity rides
+        # the SHARED parked/<id>/ continuation dirs instead)
+        return os.path.join(self._replica_dir, "campaigns", tag)
 
     def _build_runner(
         self, key: tuple, k: int | None = None
@@ -730,6 +982,7 @@ class SimServer:
         self._runner = runner
         self._last_bucket = key  # round-robin cursor
         self._campaign_claims = 0  # fairness quantum consumption
+        self._claims_closed = False  # re-opened per campaign
         if self._drain:  # a signal raced the build
             runner.request_drain()
         self._sync("serve-campaign-open")
@@ -784,6 +1037,26 @@ class SimServer:
                 "serve_fleet_devices_busy",
                 "devices executing campaign work right now",
             ).set(0)
+            # hand the bucket lease back (root's file, host-local IO —
+            # safe on the exception path too).  The release is ordered
+            # AFTER every queue write of this campaign; a fenced lease
+            # (LeaseLost) means a survivor already owns the bucket.
+            if self._fleet is not None and self._lease is not None:
+                from .fleet.lease import LeaseLost
+
+                with self._hb_lock:
+                    lease, self._lease = self._lease, None
+                if lease is not None:
+                    try:
+                        lease.release()
+                        self._journal(
+                            {"event": "lease_released", "bucket": lease.tag}
+                        )
+                    except LeaseLost:
+                        self._journal(
+                            {"event": "lease_fenced", "bucket": lease.tag}
+                        )
+            self._fenced = False
         self._sync("serve-campaign-close")
 
     def _try_resume(self, runner) -> None:
@@ -968,13 +1241,11 @@ class SimServer:
                 }
                 kept += 1
             else:
-                # park: the trajectory stays continuable in THIS process;
-                # the queued request record is the durable fallback (a
-                # crash before the park is claimed restarts it from scratch)
-                self._parked[req.id] = (
-                    state,
-                    int(entry["base"]),
-                    float(entry["time_base"]),
+                # park: the trajectory stays continuable in this process
+                # (and, fleet mode, durably in parked/<id>/ — a crash
+                # before the park is re-claimed no longer restarts it)
+                self._park_member(
+                    req, state, int(entry["base"]), float(entry["time_base"])
                 )
                 parked += 1
                 if self._is_root():
@@ -1069,6 +1340,11 @@ class SimServer:
         idle = [s.index for s in slots if not s.running]
         if not idle:  # identical slot tables on every host: consistent skip
             return
+        if self._claims_closed:
+            # a cross-bucket preemption closed this campaign: freed lanes
+            # stay idle so the campaign drains (flag is derived from a
+            # broadcast plan — identical on every host, consistent skip)
+            return
 
         def plan_fill():
             plan = {"assign": [], "quantum": False, "claims": self._campaign_claims}
@@ -1078,6 +1354,8 @@ class SimServer:
                 # the signal landed on while its peers enter it — one
                 # collective out of phase, wedged fleet
                 return plan
+            if self._fleet is not None:
+                self.queue.invalidate()  # proxies feed this bucket live
             for i in idle:
                 if (
                     quantum > 0
@@ -1086,27 +1364,46 @@ class SimServer:
                 ):
                     plan["quantum"] = True
                     break
-                req = self.queue.claim(key)
+                req = self.queue.claim(key, qos=self._fleet is not None)
                 if req is None:
                     break
+                if req.amp is None:
+                    # proxy-admitted requests bypass SimServer.submit's
+                    # default-amp stamping: stamp at claim so the done
+                    # record names the IC amplitude solo reruns need
+                    req.amp = float(self.cfg.default_amp)
                 plan["claims"] += 1
                 parked = req.id in self._parked
+                durable = False
+                base, tdone = 0, 0.0
                 if parked:
-                    # requeue-with-state continuation (elastic shrink / dt
-                    # re-bucket): the remaining debt is the request's
-                    # horizon minus the sim time already covered, at the
-                    # CURRENT bucket's dt (re-buckets change it)
                     _, base, tdone = self._parked[req.id]
+                elif self._fleet is not None:
+                    # cross-replica continuation: the park was persisted
+                    # by a (possibly dead) peer — the manifest carries the
+                    # progress accounting, the shards the member state
+                    meta = checkpoint.continuation_meta(
+                        checkpoint.continuation_dir(self.cfg.run_dir, req.id)
+                    )
+                    if meta is not None:
+                        durable = True
+                        base, tdone = meta
+                if parked or durable:
+                    # requeue-with-state continuation (elastic shrink / dt
+                    # re-bucket / preemption): the remaining debt is the
+                    # request's horizon minus the sim time already
+                    # covered, at the CURRENT bucket's dt
                     target = base + max(
                         1, round((float(req.horizon) - tdone) / float(req.dt))
                     )
                 else:
-                    base, tdone, target = 0, 0.0, req.steps
+                    target = req.steps
                 plan["assign"].append(
                     {
                         "slot": i,
                         "req": req.to_json(),
                         "parked": parked,
+                        "durable": durable,
                         "base": base,
                         "time_base": tdone,
                         "target": target,
@@ -1132,6 +1429,40 @@ class SimServer:
                 # decisions are broadcast) — a missing one is a bug, not a
                 # fallback case
                 state, _, _ = self._parked.pop(req.id)
+            elif a.get("durable"):
+                # a peer's durable park (it may be dead — that is the
+                # point): restore mid-flight; a failed verification
+                # degrades to a fresh trajectory with the debt reset —
+                # by FLEET-AGREED verdict, so no host can restore while
+                # a peer with a torn shard starts over
+                state = self._load_continuation(req, ens, slot.index)
+                if self._continuation_agreed(state is not None):
+                    _tm.counter(
+                        "serve_continuations_resumed_total",
+                        "requests resumed mid-flight from durable parked state",
+                    ).inc()
+                    self._journal(
+                        {
+                            "event": "continuation_resumed",
+                            "id": req.id,
+                            "trace_id": req.trace_id,
+                            "steps": int(a["base"]),
+                            "time": float(a["time_base"]),
+                        }
+                    )
+                else:
+                    if state is not None:
+                        self._journal(
+                            {
+                                "event": "continuation_restore_failed",
+                                "id": req.id,
+                                "error": "a peer host failed its shard read",
+                            }
+                        )
+                    a = {**a, "base": 0, "time_base": 0.0, "target": req.steps}
+                    state = ens.fresh_member_state(
+                        req.seed, req.amp or self.cfg.default_amp
+                    )
             else:
                 state = ens.fresh_member_state(
                     req.seed, req.amp or self.cfg.default_amp
@@ -1254,6 +1585,16 @@ class SimServer:
                 self._settle_predivergence(runner, ens, slots, key)
             with _tr.span("serve_settle", step=runner.step):
                 self._settle_boundary(runner, ens, slots, key)
+            if self._fleet is not None:
+                # fleet boundary work (config-aligned guard: every host
+                # holds the same cfg, so the broadcasts inside stay in
+                # lockstep): liveness heartbeat + lease renewal, the
+                # fencing verdict, and deadline-driven preemption
+                self._fleet_heartbeat()
+                if self._fence_check(ens, slots, key):
+                    return
+                self._maybe_preempt(runner, ens, slots, key)
+                self._persist_running_continuations(ens, slots)
             self._refresh_slot_state(slots, ens.k)
             self._boundary_gauges()
             # boundary housekeeping: deferred sharded commit + cadence
@@ -1282,6 +1623,107 @@ class SimServer:
         if root:
             for path in checkpoint.checkpoint_files(runner.run_dir):
                 checkpoint.remove_checkpoint(path)
+
+    def _fence_check(self, ens, slots: list[_Slot], key: tuple) -> bool:
+        """Fleet fencing at a chunk boundary: did a survivor break this
+        replica's lease (we stalled past the TTL) and re-claim the bucket?
+        Root's verdict is broadcast; a fenced campaign is ABANDONED — the
+        lanes go idle in memory and NOT one queue write is made, because
+        every request now durably belongs to the new lease holder (the
+        breaker already re-enqueued them)."""
+        fenced = bool(self._root_plan(lambda: self._fenced))
+        if not fenced:
+            return False
+        for s in slots:
+            if s.running:
+                self._release(ens, s)
+        # the in-memory parks are stale the moment we are fenced: the new
+        # lease holder may progress/re-bucket those requests and write
+        # NEWER durable continuations, which a surviving _parked entry
+        # would shadow on a later re-claim (plan_fill prefers the memory
+        # fast path).  Durable state is authoritative across a fence.
+        self._parked.clear()
+        self._journal({"event": "campaign_fenced", "key": list(key)})
+        self._fenced = False
+        return True
+
+    def _maybe_preempt(self, runner, ens, slots: list[_Slot], key: tuple) -> None:
+        """Deadline-driven preemption (the QoS contract's teeth): when a
+        queued deadline request's slack runs below the configured
+        threshold, park running best-effort lanes for it — through the
+        SAME requeue-with-state machinery as an elastic shrink, now
+        durable, so the preempted request loses nothing.  Root plans
+        (queue scan + policy), the plan is broadcast, every host executes
+        the identical lane ops."""
+        if not self._fleet.preempt:
+            return
+        done = np.asarray(ens.steps_done)  # lint-ok: RPD005 replicated (K,) host-fetched counter, identical per host
+
+        def decide():
+            from .fleet import qos as _qos
+
+            self.queue.invalidate()
+            loaded = self.queue.snapshot_queued()
+            at_risk = _qos.find_at_risk(
+                loaded, float(self._fleet.preempt_slack_s)
+            )
+            if at_risk is None:
+                return {"victims": [], "for": None}
+            running = [(s.index, s.req) for s in slots if s.running]
+            victims = _qos.preempt_victims(running, at_risk, key)
+            by_index = {s.index: s for s in slots}
+            return {
+                "for": at_risk.id,
+                "for_priority": at_risk.priority,
+                # a CROSS-bucket emergency must also close this campaign's
+                # claims: the parked victims land back in THIS bucket's
+                # queue, and an open refill would re-claim them at the
+                # same boundary — park/requeue churn forever, the urgent
+                # bucket never reached
+                "cross_bucket": tuple(at_risk.compat_key) != tuple(key),
+                "victims": [
+                    {
+                        "slot": i,
+                        "steps": by_index[i].base + int(done[i]),
+                        "time": by_index[i].time_base
+                        + int(done[i]) * float(by_index[i].req.dt),
+                    }
+                    for i in victims
+                ],
+            }
+
+        plan = self._root_plan(decide)
+        if plan["victims"] and plan.get("cross_bucket"):
+            # every host computes this from the broadcast plan: the
+            # campaign stops claiming, drains its remaining lanes, and
+            # ends — the QoS-ordered bucket pick then takes the urgent one
+            self._claims_closed = True
+        for entry in plan["victims"]:
+            s = slots[entry["slot"]]
+            req = s.req
+            state = ens.member_state(s.index)  # device op, all hosts
+            self._release(ens, s)
+            self._park_member(req, state, entry["steps"], entry["time"])
+            if self._is_root():
+                self.queue.requeue(
+                    dataclasses.replace(req, progress=int(entry["steps"]))
+                )
+            self._preempted += 1
+            _tm.counter(
+                "serve_preemptions_total",
+                "best-effort lanes parked for at-risk deadline requests",
+            ).inc()
+            self._journal(
+                {
+                    "event": "request_preempted",
+                    "id": req.id,
+                    "trace_id": req.trace_id,
+                    "slot": entry["slot"],
+                    "priority": req.priority,
+                    "steps_done": entry["steps"],
+                    "preempted_for": plan["for"],
+                }
+            )
 
     def _flush_reqtrace(self, runner, key: tuple) -> None:
         """Gather every host's request-trace events for the closing
@@ -1369,6 +1811,140 @@ class SimServer:
         slot.base = 0
         slot.time_base = 0.0
 
+    def _park_member(self, req, state, base: int, time_base: float) -> None:
+        """Park one mid-flight member state for later continuation (an
+        elastic shrink, a dt re-bucket, a QoS preemption).  Always held in
+        memory — the fast path for a park re-claimed by THIS process — and
+        in fleet mode ALSO persisted through the two-phase continuation
+        writer into the shared ``parked/<id>/`` dir, so requeue-with-state
+        survives replica SIGKILL: any replica resumes the trajectory
+        mid-flight instead of restarting it from step 0.  (On a
+        multi-process replica the persist is collective, and every host
+        reaches it through the same broadcast plan that parked the lane.)"""
+        self._parked[req.id] = (state, int(base), float(time_base))
+        if self._fleet is None or not self._fleet.durable_park:
+            return
+        self._write_continuation(req, state, int(base), float(time_base))
+
+    def _write_continuation(self, req, state, base: int, time_base: float) -> bool:
+        """Persist one member state into the shared ``parked/<id>/``
+        continuation dir (two-phase; collective on multi-process — every
+        host reaches this through a broadcast plan)."""
+        cdir = checkpoint.continuation_dir(self.cfg.run_dir, req.id)
+        try:
+            checkpoint.write_continuation(
+                cdir,
+                state,
+                base=int(base),
+                time_base=float(time_base),
+                meta={"id": req.id, "dt": float(req.dt)},
+            )
+        except (checkpoint.CheckpointError, OSError) as exc:
+            # degrade to the PR-10 behavior (in-memory park + queued
+            # record): the request survives, only the mid-flight resume
+            # across a replica death is lost for this persist
+            self._journal(
+                {
+                    "event": "continuation_persist_failed",
+                    "id": req.id,
+                    "error": str(exc),
+                }
+            )
+            return False
+        self._continuations += 1
+        _tm.counter(
+            "serve_continuations_persisted_total",
+            "parked member states persisted into parked/<id>/ dirs",
+        ).inc()
+        self._journal(
+            {
+                "event": "continuation_persisted",
+                "id": req.id,
+                "trace_id": req.trace_id,
+                "steps": int(base),
+                "time": float(time_base),
+            }
+        )
+        return True
+
+    def _persist_running_continuations(self, ens, slots: list[_Slot]) -> None:
+        """Fleet cadence persist: flow every RUNNING slot's member state
+        into its ``parked/<id>/`` continuation dir, so a replica SIGKILL
+        loses at most one cadence window of progress — the survivor that
+        breaks our lease re-claims the requests and resumes them
+        MID-FLIGHT from this state (campaign checkpoints cannot serve
+        that role: they live under the dead replica's private subtree and
+        restore only onto its exact slot geometry).  The cadence verdict
+        is root-decided and broadcast (wall clocks are host-local); the
+        per-slot work then executes identically everywhere."""
+        running = [s for s in slots if s.running]
+        if not running:
+            return
+        cadence = self._fleet.resolved_heartbeat()
+        due = bool(
+            self._root_plan(
+                lambda: (time.monotonic() - self._cont_mark) > cadence
+            )
+        )
+        if not due:
+            return
+        self._cont_mark = time.monotonic()
+        done = np.asarray(ens.steps_done)  # lint-ok: RPD005 replicated (K,) host-fetched counter, identical per host
+        for s in running:
+            state = ens.member_state(s.index)  # device op, all hosts
+            self._write_continuation(
+                s.req,
+                state,
+                s.base + int(done[s.index]),
+                s.time_base + int(done[s.index]) * float(s.req.dt),
+            )
+
+    def _load_continuation(self, req, ens, slot_index: int):
+        """Restore one durable continuation for a claimed request (the
+        cross-replica resume path: the park was made by a replica that is
+        gone).  None on verification failure.  The caller must agree the
+        use/degrade verdict ACROSS HOSTS before acting (a per-host fall
+        back would hand different lanes different states) — so success is
+        journaled there, not here."""
+        cdir = checkpoint.continuation_dir(self.cfg.run_dir, req.id)
+        template = ens.member_state(slot_index)
+        try:
+            state, _, _ = checkpoint.read_continuation(cdir, template)
+        except checkpoint.CheckpointError as exc:
+            self._journal(
+                {
+                    "event": "continuation_restore_failed",
+                    "id": req.id,
+                    "error": str(exc),
+                }
+            )
+            return None
+        return state
+
+    def _continuation_agreed(self, ok: bool) -> bool:
+        """Every host restored its continuation shard, fleet-agreed: the
+        allgather makes the degrade verdict identical everywhere (one
+        host's torn shard must not leave it on a fresh trajectory while
+        its peers resume mid-flight).  Identity single-process."""
+        if self._nproc() == 1:
+            return ok
+        from ..parallel import multihost
+
+        flags = multihost.allgather_host(
+            np.asarray([1 if ok else 0], np.uint8)
+        )
+        return bool(np.asarray(flags).all())  # lint-ok: RPD005 allgather output is host numpy already
+
+    def _retire_continuation(self, req) -> None:
+        """Root-only cleanup once a request terminally resolved (or
+        discarded its trajectory): the parked continuation no longer
+        describes anything resumable."""
+        if self._fleet is None or not self._is_root():
+            return
+        checkpoint.remove_continuation(
+            checkpoint.continuation_dir(self.cfg.run_dir, req.id)
+        )
+
     def _settle_predivergence(
         self, runner, ens, slots: list[_Slot], key: tuple
     ) -> None:
@@ -1433,11 +2009,7 @@ class SimServer:
             req = s.req
             state = ens.member_state(s.index)  # finite: rolled-back chunk
             self._release(ens, s)
-            self._parked[req.id] = (
-                state,
-                int(entry["steps"]),
-                float(entry["time"]),
-            )
+            self._park_member(req, state, int(entry["steps"]), float(entry["time"]))
             if self._is_root():
                 self.queue.requeue(
                     req.rebucketed(plan["new_dt"], progress=int(entry["steps"]))
@@ -1472,6 +2044,11 @@ class SimServer:
         — one member's NaN never perturbs its co-batched neighbours."""
         req = slot.req
         self._release(ens, slot)
+        # a diverged trajectory is not worth resuming: whatever durable
+        # continuation described it is poison for the retry (which
+        # restarts from a fresh IC at a smaller dt) and noise after a
+        # terminal failure — retire it either way
+        self._retire_continuation(req)
         if req.retries < self.cfg.request_max_retries:
             retry = req.backed_off(self.cfg.request_dt_backoff)
             if self._is_root():
@@ -1542,6 +2119,15 @@ class SimServer:
                         "model": str(req.model),
                         "steps": item["steps"],
                         "dt": float(req.dt),
+                        # the QoS contract's accounting axes: per-class
+                        # latency percentiles in the fleet bench read these
+                        "tenant": str(req.tenant),
+                        "priority": str(req.priority),
+                        "deadline_s": (
+                            float(req.deadline_s)
+                            if req.deadline_s is not None
+                            else None
+                        ),
                         "seed": int(req.seed),
                         # IC amplitude rides the record so solo-equivalence
                         # checks rerun the exact trajectory
@@ -1587,6 +2173,14 @@ class SimServer:
                     "serve_admission_to_first_observable_seconds",
                     "durable enqueue to first streamed observable",
                 ).observe(first_obs_s)
+                # the per-class view of the same clock: the QoS contract's
+                # gate metric (interactive p99 under mixed traffic)
+                _tm.histogram(
+                    "serve_class_latency_seconds",
+                    "enqueue to first observable per QoS priority class",
+                    **{"class": str(req.priority)},
+                ).observe(first_obs_s)
+                self._retire_continuation(req)
                 self._journal(
                     {
                         "event": "request_done",

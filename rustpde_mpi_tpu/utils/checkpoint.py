@@ -80,6 +80,7 @@ import numpy as np
 from ..bases import BaseKind, Space2
 
 from ..config import env_get
+from . import fsutil
 from .fsutil import fsync_dir
 from ..field import grid_deltas
 
@@ -1434,3 +1435,224 @@ def read_sharded_snapshot(pde, filename: str) -> None:
     with scope():
         pde.apply_restored_state(updates, attrs, root)
     print(f" <== {filename} (sharded, {int(attrs['sharded'])} shard(s))")
+
+
+# -- durable parked continuations (serve/fleet) -------------------------------
+#
+# A parked mid-flight member state (elastic shrink, proactive dt
+# re-bucket, QoS preemption) was process-local in PR 10: a replica death
+# before the park was re-claimed restarted that request from step 0.  The
+# fleet layer persists each park as a per-request continuation dir,
+# two-phase like every other durable write in this file:
+#
+#     parked/<request-id>/shard_00000.h5   per-process state slabs,
+#                                          digest-stamped, atomic
+#     parked/<request-id>/manifest.json    the COMMIT MARKER (atomic
+#                                          rename + dirsync): a crash
+#                                          mid-write leaves shards with
+#                                          no manifest = no continuation
+#
+# so ANY replica that later claims the request resumes the trajectory
+# mid-flight from durable state instead of restarting.
+
+CONTINUATION_MANIFEST = "manifest.json"
+
+
+def continuation_dir(run_dir: str, request_id: str) -> str:
+    """``<run_dir>/parked/<id>`` — one continuation dir per request."""
+    return os.path.join(run_dir, "parked", str(request_id))
+
+
+def continuation_exists(cont_dir: str) -> bool:
+    """True when a COMMITTED continuation is present (manifest = marker)."""
+    return os.path.exists(os.path.join(cont_dir, CONTINUATION_MANIFEST))
+
+
+def continuation_meta(cont_dir: str) -> tuple[int, float] | None:
+    """``(base_steps, time_base)`` of a committed continuation — the
+    host-side progress accounting a scheduler plan needs BEFORE deciding
+    to restore the (much larger) state shards; None when no committed
+    continuation exists."""
+    try:
+        with open(
+            os.path.join(cont_dir, CONTINUATION_MANIFEST), encoding="utf-8"
+        ) as fh:
+            record = json.load(fh)
+        return int(record["base"]), float(record["time_base"])
+    except (OSError, ValueError, KeyError):
+        return None
+
+
+def write_continuation(
+    cont_dir: str, state, *, base: int, time_base: float, meta: dict | None = None
+) -> str:
+    """Persist one parked member state, two-phase (collective on a
+    multi-process runtime — every host calls this together, like the
+    sharded checkpoint writer it mirrors): each process writes its
+    host-local state slabs to ``shard_<p>.h5`` (fsynced, digest-stamped),
+    digests are exchanged, then ROOT atomically writes the manifest whose
+    presence commits the continuation.  Raises :class:`CheckpointError`
+    on a failed shard write (no manifest is committed)."""
+    from ..parallel import multihost
+
+    proc = _process_index()
+    nproc = _process_count()
+    fields = list(state._fields)
+    slabs = {name: multihost.host_local_array(getattr(state, name)) for name in fields}
+    items = [(f"state/{name}", arr, "raw") for name, arr in sorted(slabs.items())]
+    digest = snapshot_digest(items)
+    shard_file = os.path.join(cont_dir, f"shard_{proc:05d}.h5")
+
+    def body(h5):
+        grp = h5.require_group("state")
+        for name in fields:
+            grp.create_dataset(name, data=slabs[name])
+        h5.attrs["shard_index"] = int(proc)
+        h5.attrs["shard_count"] = int(nproc)
+
+    local_error: Exception | None = None
+    try:
+        _atomic_h5_write(shard_file, body, step=int(base), digest=digest)
+    except Exception as exc:  # noqa: BLE001 — the commit exchange decides
+        local_error = exc
+    if nproc == 1:
+        digests, oks = [digest], [local_error is None]
+    else:
+        # the allgather doubles as the phase barrier: it completes only
+        # after every host's shard write attempt resolved
+        rows = multihost.allgather_bytes(
+            json.dumps(
+                {"digest": digest, "ok": local_error is None}
+            ).encode("utf-8")
+        )
+        parsed = [json.loads(r.decode("utf-8")) for r in rows]
+        digests = [p["digest"] for p in parsed]
+        oks = [bool(p["ok"]) for p in parsed]
+    manifest = os.path.join(cont_dir, CONTINUATION_MANIFEST)
+    if not all(oks):
+        if nproc > 1:
+            multihost.sync_hosts("rustpde-continuation-abort")
+        raise CheckpointError(
+            manifest,
+            "continuation persist aborted: a host failed its shard write "
+            "(no manifest committed)"
+            + (f"; local cause: {local_error}" if local_error else ""),
+        ) from local_error
+    if proc == 0:
+        record = {
+            "schema": SCHEMA_VERSION,
+            "base": int(base),
+            "time_base": float(time_base),
+            "fields": fields,
+            "shards": [
+                {"file": f"shard_{i:05d}.h5", "digest": d}
+                for i, d in enumerate(digests)
+            ],
+            "meta": dict(meta or {}),
+        }
+        # the COMMIT marker: strict dirsync — a failed dirsync must
+        # report the continuation NOT committed
+        fsutil.atomic_write_text(
+            manifest, json.dumps(record, sort_keys=True), strict=True
+        )
+    if nproc > 1:
+        multihost.sync_hosts("rustpde-continuation-commit")
+    return manifest
+
+
+def read_continuation(cont_dir: str, template_state):
+    """Restore a committed continuation: ``(state, base, time_base)``.
+
+    Each process reads ITS shard (digest-verified end-to-end), checks
+    every leaf's shape/dtype against ``template_state`` (a donor member
+    state of the claiming ensemble — same compat bucket, so same shapes
+    by construction), and on a multi-process runtime reassembles the
+    host-local slabs into global arrays with the template leaf's
+    sharding.  Raises :class:`CheckpointError` on a missing/uncommitted
+    continuation or any verification failure — callers degrade to a
+    from-scratch restart, never a torn state."""
+    import h5py
+
+    from ..parallel import multihost
+
+    manifest = os.path.join(cont_dir, CONTINUATION_MANIFEST)
+    try:
+        with open(manifest, encoding="utf-8") as fh:
+            record = json.load(fh)
+    except (OSError, ValueError) as exc:
+        raise CheckpointError(
+            manifest, f"no committed continuation: {exc}"
+        ) from exc
+    fields = list(record.get("fields", ()))
+    if fields != list(template_state._fields):
+        raise CheckpointError(
+            manifest,
+            f"continuation fields {fields} != state fields "
+            f"{list(template_state._fields)} (model kind changed?)",
+        )
+    proc = _process_index()
+    shards = record.get("shards", [])
+    if proc >= len(shards):
+        raise CheckpointError(
+            manifest,
+            f"continuation holds {len(shards)} shard(s) but this is "
+            f"process {proc}: written under a different topology",
+        )
+    path = os.path.join(cont_dir, shards[proc]["file"])
+    with _open_checkpoint(path) as h5:
+        attrs = _attrs_of(h5)
+        if attrs.get("digest") != shards[proc]["digest"]:
+            raise CheckpointError(
+                manifest, f"shard {shards[proc]['file']!r} digest mismatch"
+            )
+        if content_digest(h5) != shards[proc]["digest"]:
+            raise CheckpointError(
+                manifest, f"shard {shards[proc]['file']!r} content mismatch"
+            )
+        slabs = {name: np.asarray(h5["state"][name]) for name in fields}
+    leaves = {}
+    for name in fields:
+        tmpl = getattr(template_state, name)
+        slab = slabs[name]
+        if _process_count() == 1:
+            if tuple(slab.shape) != tuple(tmpl.shape) or str(
+                np.dtype(slab.dtype)
+            ) != str(np.dtype(tmpl.dtype)):
+                raise CheckpointError(
+                    manifest,
+                    f"{name}: continuation {slab.shape}/{slab.dtype} != "
+                    f"state {tuple(tmpl.shape)}/{tmpl.dtype}",
+                )
+            leaves[name] = slab
+        else:
+            leaves[name] = multihost.global_array(slab, tmpl.sharding)
+    return (
+        type(template_state)(**leaves),
+        int(record.get("base", 0)),
+        float(record.get("time_base", 0.0)),
+    )
+
+
+def remove_continuation(cont_dir: str) -> None:
+    """Retire a consumed continuation: the MANIFEST goes first (atomic
+    uncommit — a crash mid-removal leaves shards with no marker, which
+    reads as "no continuation", never a torn one), then the shards and
+    the dir itself.  Root-only on multi-process runtimes (host-local
+    filesystem work; the caller fences)."""
+    manifest = os.path.join(cont_dir, CONTINUATION_MANIFEST)
+    try:
+        os.remove(manifest)
+        fsync_dir(cont_dir)
+    except OSError:
+        pass
+    try:
+        for name in os.listdir(cont_dir):
+            try:
+                os.remove(os.path.join(cont_dir, name))
+            except OSError:
+                pass
+        fsync_dir(cont_dir)
+        os.rmdir(cont_dir)
+        fsync_dir(os.path.dirname(cont_dir) or ".")
+    except OSError:
+        pass

@@ -17,6 +17,24 @@ from __future__ import annotations
 import os
 
 
+def atomic_write_text(path: str, text: str, strict: bool = False) -> None:
+    """The one durable small-file write: tmp sibling (pid-suffixed), write
+    + flush + fsync, ``os.replace`` over the target, parent dirsync.  The
+    queue's request files, the fleet's lease/heartbeat records and the
+    continuation manifest all ride this exact sequence — extracted here so
+    four durability-critical modules cannot drift apart (one copy quietly
+    losing its dirsync is how the rename-rollback bug returns).
+    ``strict`` propagates a failed dirsync (commit-marker writers must
+    report such a write FAILED, not committed)."""
+    tmp = f"{path}.{os.getpid()}.tmp"
+    with open(tmp, "w", encoding="utf-8") as fh:
+        fh.write(text)
+        fh.flush()
+        os.fsync(fh.fileno())
+    os.replace(tmp, path)
+    fsync_dir(os.path.dirname(path) or ".", strict=strict)
+
+
 def fsync_dir(path: str, strict: bool = False) -> None:
     """fsync a DIRECTORY so a just-renamed/removed dirent survives power
     loss.  Default is best-effort (filesystems that reject directory fsync

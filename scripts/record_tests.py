@@ -108,6 +108,10 @@ def main() -> int:
         # scheduler) — the multihost serving path gets the same tracked
         # record the two-phase writer has
         "serve_mp": _serve_mp_counters(),
+        # HA-fleet counters from the serve129 fleet leg (replicas
+        # spawned, leases broken, preemptions, zero-lost flag) — the
+        # replicated front door gets the same tracked record
+        "fleet": _fleet_counters(),
         # per-model solo-vs-ensemble parity deltas (workloads satellite):
         # recorded into PARITY.json too, so cross-model vmap/scan drift
         # shows up per-PR next to the Nu-parity numbers
@@ -245,6 +249,38 @@ def _serve_mp_counters() -> dict | None:
                 "error",
             )
             if key in mp
+        }
+    except (OSError, ValueError, KeyError):
+        return None
+
+
+def _fleet_counters() -> dict | None:
+    """HA-fleet counters from BENCH_FULL.json's ``serve129`` fleet leg
+    (proxy + 2 leased replicas, replica SIGKILL mid-campaign): replicas
+    spawned, leases broken, preemptions, break->reclaim latency and the
+    zero-lost / reclaimed-with-state flags.  None when the config was
+    never benched — or predates the fleet layer."""
+    try:
+        with open(os.path.join(_REPO, "BENCH_FULL.json")) as f:
+            row = json.load(f)["results"]["serve129"]
+        fleet = row.get("fleet")
+        if not isinstance(fleet, dict):
+            return None
+        return {
+            key: fleet.get(key)
+            for key in (
+                "replicas",
+                "proxies",
+                "requests",
+                "leases_broken",
+                "preemptions",
+                "resumed_mid_flight",
+                "lease_break_to_reclaim_s",
+                "zero_lost",
+                "reclaimed_with_state",
+                "error",
+            )
+            if key in fleet
         }
     except (OSError, ValueError, KeyError):
         return None

@@ -38,6 +38,11 @@ _CASES = {
     ],
     "navier_rbc_roughness.py": ["--quick"],
     "navier_rbc_scenarios.py": ["--quick"],
+    # an idle fleet replica in batch mode: fleet init + lease manager +
+    # heartbeat publication + the idle-done handshake, then a clean exit
+    "navier_rbc_fleet.py": [
+        "--replica", "--replica-id", "smoke", "--run-dir", "data/fleet_smoke",
+    ],
     "navier_lnse_eigenmodes.py": ["--quick", "--run-dir", "data/eig_smoke"],
     "navier_mpi.py": ["--quick"],
     "navier_rbc_steady.py": ["--quick"],

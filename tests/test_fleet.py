@@ -1,0 +1,908 @@
+"""Fleet-layer tests (rustpde_mpi_tpu/serve/fleet/): queue-level bucket
+leases with fencing tokens and clock-robust staleness, the stateless
+HTTP proxy tier, the QoS traffic contract (quotas / priority classes /
+deadlines / preemption), durable parked continuations, the queued-dir
+listing cache, and the fleet-off invariant (zero extra journal rows).
+
+The multi-replica SIGKILL chaos soak (proxy + 2 replicas, one killed
+mid-campaign while holding leases and parked continuations) lives in the
+slow tier; the tier-1 tests here exercise every protocol transition at
+small scale, most without any device work at all.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from rustpde_mpi_tpu import Navier2D
+from rustpde_mpi_tpu.config import FleetConfig, ServeConfig
+from rustpde_mpi_tpu.serve import (
+    AdmissionError,
+    DurableQueue,
+    FleetProxy,
+    LeaseLost,
+    LeaseManager,
+    RequestError,
+    SimRequest,
+    SimServer,
+)
+from rustpde_mpi_tpu.serve.fleet import qos
+from rustpde_mpi_tpu.serve.fleet.lease import bucket_tag
+from rustpde_mpi_tpu.utils import checkpoint
+from rustpde_mpi_tpu.utils.journal import read_journal
+
+h5py = pytest.importorskip("h5py")
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# the shared tier shapes (tests/model_builders.py): 17^2 rbc, dt=0.01
+_REQ = dict(ra=1e4, pr=1.0, nx=17, ny=17, dt=0.01, horizon=0.1, bc="rbc")
+_KEY = SimRequest(**_REQ).compat_key
+
+
+def _cfg(tmp_path, **kw):
+    kw.setdefault("run_dir", str(tmp_path / "fleet"))
+    kw.setdefault("slots", 2)
+    kw.setdefault("chunk_steps", 4)
+    kw.setdefault("checkpoint_every_s", None)
+    kw.setdefault("http_port", None)
+    return ServeConfig(**kw)
+
+
+def _replica_events(run_dir, rid):
+    return read_journal(
+        os.path.join(run_dir, "replicas", rid, "journal.jsonl")
+    )
+
+
+# -- lease protocol (no jax, no server) ---------------------------------------
+
+
+def test_lease_claim_renew_release_and_tokens(tmp_path):
+    root = str(tmp_path / "leases")
+    m1 = LeaseManager(root, "r1", ttl_s=60.0)
+    m2 = LeaseManager(root, "r2", ttl_s=60.0)
+    lease = m1.claim(_KEY)
+    assert lease is not None and lease.token == 1
+    assert lease.tag == bucket_tag(_KEY)
+    # held: a second replica cannot claim (sweep's business, not claim's)
+    assert m2.claim(_KEY) is None
+    lease.renew()
+    lease.guard()
+    # clean release escrows the token; the next claim is strictly newer
+    lease.release()
+    lease2 = m2.claim(_KEY)
+    assert lease2 is not None and lease2.token == 2
+    # the released holder is fenced on every surface
+    with pytest.raises(LeaseLost):
+        lease.guard()
+    with pytest.raises(LeaseLost):
+        lease.renew()
+
+
+def test_lease_claim_race_exactly_one_winner(tmp_path):
+    """Two replicas race one bucket's lease file concurrently, many
+    rounds: exactly one claim succeeds per round (the exclusive-dirent
+    protocol's whole point)."""
+    root = str(tmp_path / "leases")
+    mgrs = [LeaseManager(root, f"r{i}", ttl_s=60.0) for i in range(4)]
+    for _ in range(10):
+        wins, barrier = [], threading.Barrier(len(mgrs))
+
+        def race(m):
+            barrier.wait()
+            lease = m.claim(_KEY)
+            if lease is not None:
+                wins.append(lease)
+
+        threads = [threading.Thread(target=race, args=(m,)) for m in mgrs]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert len(wins) == 1, [w.owner for w in wins]
+        wins[0].release()
+
+
+def test_lease_stale_break_and_fencing(tmp_path):
+    """break-then-reclaim ordering: a stale lease is broken by exactly one
+    survivor, the re-claim gets a strictly greater fencing token, and the
+    stale holder's writes are rejected from then on."""
+    root = str(tmp_path / "leases")
+    m1 = LeaseManager(root, "dead", ttl_s=0.1)
+    m2 = LeaseManager(root, "live", ttl_s=0.1)
+    m3 = LeaseManager(root, "late", ttl_s=0.1)
+    lease = m1.claim(_KEY)
+    assert lease.token == 1
+    # observer-monotonic staleness: first observation opens a full TTL
+    assert m2.stale(lease.tag) is False
+    time.sleep(0.15)
+    assert m2.stale(lease.tag) is True
+    # two survivors race the break: the rename's vanishing source lets
+    # exactly one through
+    assert m3.stale(lease.tag) is False  # late observer: fresh window
+    broken = m2.break_lease(lease.tag)
+    assert broken is not None and broken["owner"] == "dead"
+    assert m2.break_lease(lease.tag) is None  # raced: source is gone
+    relcaim = m2.claim(_KEY)
+    assert relcaim.token == 2  # strictly past every token ever issued
+    # the stale holder is FENCED: renew and guard both reject
+    with pytest.raises(LeaseLost):
+        lease.renew()
+    with pytest.raises(LeaseLost):
+        lease.guard()
+
+
+def test_lease_clock_skew_grants_extra_ttl(tmp_path):
+    """The NTP-step satellite: a heartbeat mtime that jumps BACKWARDS is
+    a clock artifact, not a death — the lease reads live for one extra
+    TTL instead of being instantly broken."""
+    root = str(tmp_path / "leases")
+    holder = LeaseManager(root, "h", ttl_s=0.2)
+    watcher = LeaseManager(root, "w", ttl_s=0.2)
+    lease = holder.claim(_KEY)
+    assert watcher.stale(lease.tag) is False  # first sight: window opens
+    time.sleep(0.25)
+    # an NTP step: the lease file's mtime moves BACKWARDS with no renew
+    past = time.time() - 3600.0
+    os.utime(lease.path, (past, past))
+    # the change restarts the observation window — live for one more TTL
+    assert watcher.stale(lease.tag) is False
+    time.sleep(0.25)
+    # no further change for a full TTL: NOW it is genuinely stale
+    assert watcher.stale(lease.tag) is True
+    assert watcher.sweep()[0]["owner"] == "h"
+
+
+def test_lease_resurrection_after_break_is_retracted(tmp_path):
+    """The guard-then-write race: a zombie holder whose write lands AFTER
+    a survivor broke its lease must stand down at its next renewal (the
+    token escrow moved to its token) and RETRACT the resurrected record —
+    never fence the legitimate new owner."""
+    root = str(tmp_path / "leases")
+    zombie_mgr = LeaseManager(root, "zombie", ttl_s=0.1)
+    survivor = LeaseManager(root, "survivor", ttl_s=0.1)
+    zombie = zombie_mgr.claim(_KEY)
+    survivor.stale(zombie.tag)
+    time.sleep(0.15)
+    assert survivor.break_lease(zombie.tag) is not None
+    # the zombie's stalled write lands now, resurrecting its record over
+    # the broken lease (simulated: rewrite its pre-break record)
+    with open(zombie.path, "w", encoding="utf-8") as fh:
+        json.dump(zombie_mgr._record(zombie, 1), fh)
+    # the zombie's next heartbeat hits the escrow fence and retracts
+    with pytest.raises(LeaseLost, match="escrow"):
+        zombie.renew()
+    assert not os.path.exists(zombie.path)
+    # the bucket is immediately claimable with a strictly newer token
+    lease2 = survivor.claim(_KEY)
+    assert lease2 is not None and lease2.token == 2
+    lease2.guard()
+
+
+def test_lease_break_crash_intermediate_is_adopted(tmp_path):
+    """A breaker that dies between the break rename and the escrow write
+    leaves a ``.breaking.`` intermediate; the next claim adopts its token
+    so fencing monotonicity survives the breaker's crash."""
+    root = str(tmp_path / "leases")
+    m1 = LeaseManager(root, "r1", ttl_s=60.0)
+    lease = m1.claim(_KEY)
+    # simulate the crashed breaker: rename away, never escrow
+    os.replace(lease.path, lease.path + ".breaking.crashed.1")
+    m2 = LeaseManager(root, "r2", ttl_s=60.0)
+    lease2 = m2.claim(_KEY)
+    assert lease2 is not None and lease2.token == 2
+
+
+def test_lease_heartbeat_carries_monotonic_epoch_pair(tmp_path):
+    lease = LeaseManager(str(tmp_path), "r1", ttl_s=60.0).claim(_KEY)
+    with open(lease.path, encoding="utf-8") as fh:
+        rec = json.load(fh)
+    assert {"owner", "token", "seq", "hb_unix", "hb_mono", "bucket"} <= set(rec)
+    lease.renew()
+    with open(lease.path, encoding="utf-8") as fh:
+        rec2 = json.load(fh)
+    assert rec2["seq"] == rec["seq"] + 1
+    assert rec2["hb_mono"] >= rec["hb_mono"]
+
+
+# -- QoS policy (pure host-side) ----------------------------------------------
+
+
+def test_qos_priority_validation_and_ranks():
+    req = SimRequest(**_REQ, priority="interactive", deadline_s=10.0)
+    req.validate()
+    assert req.class_rank == 0
+    assert SimRequest(**_REQ).class_rank == 1  # default: batch
+    assert SimRequest(**_REQ, priority="best-effort").class_rank == 2
+    with pytest.raises(RequestError, match="priority"):
+        SimRequest(**_REQ, priority="urgent").validate()
+    with pytest.raises(RequestError, match="deadline"):
+        SimRequest(**_REQ, deadline_s=-1.0).validate()
+    with pytest.raises(RequestError, match="tenant"):
+        SimRequest(**_REQ, tenant="").validate()
+    # tenant/priority/deadline never join the bucket key: classes co-batch
+    assert SimRequest(**_REQ, priority="interactive", tenant="a").compat_key == _KEY
+
+
+def test_qos_bucket_order_and_at_risk():
+    now = time.time()
+    mk = lambda i, **kw: (f"{i:020d}-x.json", SimRequest(**dict(_REQ, **kw)))
+    be = mk(1, dt=0.01, priority="best-effort")
+    ia = mk(2, dt=0.005, priority="interactive", deadline_s=60.0)
+    ba = mk(3, dt=0.0025)
+    order = qos.bucket_order([be, ia, ba], now)
+    assert order[0] == ia[1].compat_key  # class before arrival
+    assert order[1] == ba[1].compat_key  # batch before best-effort
+    # deadline slack breaks ties inside a class
+    tight = mk(4, dt=0.02, priority="interactive", deadline_s=1.0)
+    assert qos.bucket_order([ia, tight], now)[0] == tight[1].compat_key
+    # at-risk: only deadline-carrying requests under the slack threshold
+    assert qos.find_at_risk([be, ba], 30.0, now) is None
+    assert qos.find_at_risk([ia], 30.0, now) is None  # 60s slack > 30s
+    assert qos.find_at_risk([tight], 30.0, now).id == tight[1].id
+
+
+def test_qos_preempt_victims_class_rules():
+    at_risk = SimRequest(**_REQ, priority="interactive", deadline_s=1.0)
+    be1 = SimRequest(**_REQ, priority="best-effort")
+    be2 = SimRequest(**_REQ, priority="best-effort")
+    batch = SimRequest(**_REQ)
+    running = [(0, be1), (1, batch), (2, be2)]
+    # same bucket: exactly ONE lane frees (the at-risk refills it)
+    assert len(qos.preempt_victims(running, at_risk, _KEY)) == 1
+    # cross-bucket: every best-effort lane parks, batch is NEVER a victim
+    other = ("other",) + _KEY[1:]
+    victims = qos.preempt_victims(running, at_risk, other)
+    assert sorted(victims) == [0, 2]
+    # batch emergencies preempt nothing
+    assert qos.preempt_victims(running, SimRequest(**_REQ, deadline_s=1.0), other) == []
+
+
+def test_qos_quota_check():
+    fleet = FleetConfig(default_quota=2, quotas={"vip": None})
+    req = SimRequest(**_REQ, tenant="t1")
+    qos.check_quota(req, {"t1": 1}, fleet)  # under quota: fine
+    with pytest.raises(AdmissionError) as exc:
+        qos.check_quota(req, {"t1": 2}, fleet)
+    assert exc.value.reason == "quota" and exc.value.retry_after_s > 0
+    # per-tenant override: vip is unlimited
+    qos.check_quota(SimRequest(**_REQ, tenant="vip"), {"vip": 99}, fleet)
+
+
+# -- queued-dir listing cache (satellite) -------------------------------------
+
+
+def test_queue_listing_cache_bounds_listdir_per_boundary(tmp_path, monkeypatch):
+    """The O(all files) regression gate: after warmup, one scheduler
+    boundary's worth of queue consults (bucket order, counts-by-bucket,
+    fairness probe, a claim) costs ZERO queued-dir listdirs — the cache
+    absorbs them and stays coherent across enqueue/claim/requeue."""
+    q = DurableQueue(str(tmp_path / "q"), max_queue=64)
+    for s in range(12):
+        q.submit(SimRequest(**_REQ, seed=s))
+    calls = {"queued": 0}
+    real_listdir = os.listdir
+    queued_dir = os.path.join(str(tmp_path / "q"), "queued")
+
+    def counting(path="."):
+        if os.path.abspath(str(path)) == os.path.abspath(queued_dir):
+            calls["queued"] += 1
+        return real_listdir(path)
+
+    monkeypatch.setattr(os, "listdir", counting)
+    q.invalidate()  # start cold (submit already warmed the cache)
+    q.buckets()  # cold: one listdir warms the cache
+    assert calls["queued"] == 1
+    # one boundary's consults: order, counts, fairness probe, claim
+    calls["queued"] = 0
+    q.bucket_order()
+    q.buckets()
+    q.other_bucket_waiting(_KEY)
+    got = q.claim(_KEY)
+    assert got is not None
+    assert calls["queued"] == 0, "boundary consults must ride the cache"
+    # mutations keep the cache coherent without re-listing
+    q.requeue(got)
+    assert {r.id for _, r in q.snapshot_queued()} == {
+        r.id for _, r in q.snapshot_queued()
+    }
+    assert calls["queued"] == 0
+    # invalidate() (fleet: external writers) forces exactly one re-list
+    q.invalidate()
+    q.bucket_order()
+    assert calls["queued"] == 1
+
+
+def test_queue_claim_race_against_external_writer(tmp_path):
+    """Fleet shape: a peer replica claims a queued file between our scan
+    and our rename — the claim must skip it gracefully, never raise, and
+    the stale cache entry is evicted."""
+    q = DurableQueue(str(tmp_path / "q"), max_queue=8)
+    a = q.submit(SimRequest(**_REQ, seed=0))
+    b = q.submit(SimRequest(**_REQ, seed=1))
+    q.snapshot_queued()  # warm the cache
+    # the "peer": a second handle over the same dir steals request a
+    peer = DurableQueue(str(tmp_path / "q"), max_queue=8)
+    stolen = peer.claim()
+    assert stolen.id == a.id
+    # our stale-cached claim transparently lands on b
+    got = q.claim()
+    assert got is not None and got.id == b.id
+    assert q.claim() is None
+
+
+def test_queue_tenant_counts(tmp_path):
+    q = DurableQueue(str(tmp_path / "q"), max_queue=8)
+    q.submit(SimRequest(**_REQ, seed=0, tenant="a"))
+    q.submit(SimRequest(**_REQ, seed=1, tenant="a"))
+    q.submit(SimRequest(**_REQ, seed=2, tenant="b"))
+    assert q.tenant_counts() == {"a": 2, "b": 1}
+    q.claim()  # running still charges the tenant
+    assert q.tenant_counts() == {"a": 2, "b": 1}
+    done = q.claim()
+    q.complete(done, {"nu": 1.0})  # resolved stops charging
+    assert sum(q.tenant_counts().values()) == 2
+
+
+def test_queue_qos_claim_order(tmp_path):
+    q = DurableQueue(str(tmp_path / "q"), max_queue=8)
+    be = q.submit(SimRequest(**_REQ, seed=0, priority="best-effort"))
+    ia = q.submit(SimRequest(**_REQ, seed=1, priority="interactive"))
+    ba = q.submit(SimRequest(**_REQ, seed=2))
+    assert q.claim(_KEY).id == be.id  # plain claim is FIFO: class-blind
+    q.requeue(be)
+    # the QoS claim picks by class first, FIFO within a class
+    assert q.claim(_KEY, qos=True).id == ia.id
+    assert q.claim(_KEY, qos=True).id == ba.id
+    assert q.claim(_KEY, qos=True).id == be.id
+
+
+# -- durable continuations ----------------------------------------------------
+
+
+def test_continuation_roundtrip_and_commit_marker(tmp_path):
+    m = Navier2D(17, 17, 1e4, 1.0, 0.01, 1.0, "rbc", periodic=False)
+    m.init_random(0.1, seed=5)
+    m.update_n(3)
+    cdir = checkpoint.continuation_dir(str(tmp_path), "req123")
+    checkpoint.write_continuation(
+        cdir, m.state, base=3, time_base=0.03, meta={"id": "req123"}
+    )
+    assert checkpoint.continuation_exists(cdir)
+    assert checkpoint.continuation_meta(cdir) == (3, 0.03)
+    state, base, tbase = checkpoint.read_continuation(cdir, m.state)
+    assert base == 3 and tbase == 0.03
+    import numpy as np
+
+    for name in m.state._fields:
+        assert np.array_equal(
+            np.asarray(getattr(state, name)),
+            np.asarray(getattr(m.state, name)),
+        )
+    # the manifest is the commit marker: shards without it read as absent
+    os.remove(os.path.join(cdir, checkpoint.CONTINUATION_MANIFEST))
+    assert not checkpoint.continuation_exists(cdir)
+    assert checkpoint.continuation_meta(cdir) is None
+    with pytest.raises(checkpoint.CheckpointError, match="no committed"):
+        checkpoint.read_continuation(cdir, m.state)
+
+
+def test_continuation_digest_verification_rejects_corruption(tmp_path):
+    m = Navier2D(17, 17, 1e4, 1.0, 0.01, 1.0, "rbc", periodic=False)
+    m.init_random(0.1, seed=5)
+    cdir = checkpoint.continuation_dir(str(tmp_path), "reqX")
+    checkpoint.write_continuation(cdir, m.state, base=1, time_base=0.01)
+    shard = os.path.join(cdir, "shard_00000.h5")
+    with open(shard, "r+b") as fh:  # flip bytes mid-file
+        fh.seek(os.path.getsize(shard) // 2)
+        fh.write(b"\xde\xad\xbe\xef")
+    with pytest.raises(checkpoint.CheckpointError):
+        checkpoint.read_continuation(cdir, m.state)
+    # retire is idempotent and total
+    checkpoint.remove_continuation(cdir)
+    assert not os.path.exists(cdir)
+    checkpoint.remove_continuation(cdir)
+
+
+# -- the proxy tier -----------------------------------------------------------
+
+
+def test_proxy_submit_status_stats_and_429(tmp_path):
+    run_dir = str(tmp_path / "fleet")
+    fleet = FleetConfig(replica_id="p1", default_quota=2)
+    proxy = FleetProxy(run_dir, max_queue=3, fleet=fleet)
+    proxy.start()
+    try:
+        host, port = proxy.address
+        base = f"http://{host}:{port}"
+
+        def post(payload):
+            req = urllib.request.Request(
+                base + "/requests",
+                data=json.dumps(payload).encode(),
+                method="POST",
+            )
+            try:
+                with urllib.request.urlopen(req, timeout=30) as resp:
+                    return resp.status, json.loads(resp.read()), dict(resp.headers)
+            except urllib.error.HTTPError as err:
+                return err.code, json.loads(err.read()), dict(err.headers)
+
+        def get(path):
+            try:
+                with urllib.request.urlopen(base + path, timeout=30) as resp:
+                    return resp.status, json.loads(resp.read())
+            except urllib.error.HTTPError as err:
+                return err.code, json.loads(err.read())
+
+        code, ack, _ = post(dict(_REQ, seed=0, tenant="t1"))
+        assert code == 202 and ack["id"] and ack["trace_id"]
+        # durable: the ack'd request is on disk, claimable by any replica
+        q = DurableQueue(os.path.join(run_dir, "queue"), max_queue=3)
+        assert q.counts()["queued"] == 1
+        code, status = get(f"/requests/{ack['id']}")
+        assert code == 200 and status["state"] == "queued"
+        assert get("/requests/nope")[0] == 404
+        # malformed: typed 400, nothing admitted
+        assert post(dict(_REQ, dt=-1.0))[0] == 400
+        assert post("not a dict")[0] == 400
+        assert post(dict(_REQ, priority="nope"))[0] == 400
+        # the QoS quota: tenant t1 holds 2/2 -> 429 with Retry-After + depth
+        assert post(dict(_REQ, seed=1, tenant="t1"))[0] == 202
+        code, body, headers = post(dict(_REQ, seed=2, tenant="t1"))
+        assert code == 429 and body["reason"] == "quota"
+        assert int(headers["Retry-After"]) >= 1
+        assert body["queue_depth"] == 2 and body["retry_after_s"] >= 1
+        # other tenants are unaffected until the queue itself fills
+        assert post(dict(_REQ, seed=3, tenant="t2"))[0] == 202
+        code, body, headers = post(dict(_REQ, seed=4, tenant="t2"))
+        assert code == 429 and body["reason"] == "queue_full"
+        assert "Retry-After" in headers
+        # stats aggregate durable state: queue + tenants + leases + replicas
+        code, stats = get("/stats")
+        assert code == 200
+        assert stats["queue"]["queued"] == 3
+        assert stats["tenants"] == {"t1": 2, "t2": 1}
+        assert stats["leases"] == {} and stats["replicas"] == []
+        code, health = get("/healthz")
+        assert code == 200 and health["ok"] is True
+        assert health["replicas_alive"] == 0
+        # /metrics renders this proxy's registry
+        with urllib.request.urlopen(base + "/metrics", timeout=30) as resp:
+            assert resp.status == 200
+            text = resp.read().decode()
+        assert "fleet_proxy_requests_total" in text
+        # quota_rejected journaled in the proxy's own journal
+        events = _replica_events(run_dir, "proxy-p1")
+        names = [e["event"] for e in events]
+        assert "quota_rejected" in names and "request_admitted" in names
+    finally:
+        proxy.stop()
+
+
+def test_proxy_sees_replica_heartbeats(tmp_path):
+    from rustpde_mpi_tpu.serve.fleet.proxy import (
+        read_replica_status,
+        write_replica_heartbeat,
+    )
+
+    run_dir = str(tmp_path / "fleet")
+    write_replica_heartbeat(run_dir, "rA", {"draining": False})
+    write_replica_heartbeat(run_dir, "rB", {"draining": True})
+    status = read_replica_status(run_dir, ttl_s=60.0)
+    assert [r["replica"] for r in status] == ["rA", "rB"]
+    assert all(not r["stale"] for r in status)
+    # a heartbeat older than the ttl reads stale
+    old = os.path.join(run_dir, "replicas", "rA.json")
+    past = time.time() - 120.0
+    os.utime(old, (past, past))
+    status = read_replica_status(run_dir, ttl_s=60.0)
+    assert [r["stale"] for r in status] == [True, False]
+
+
+def test_http_front_429_carries_retry_after_and_depth(tmp_path):
+    """Satellite: the root front's 429 now carries a Retry-After header
+    and a JSON body with the live queue depth + the rejection reason."""
+    srv = SimServer(_cfg(tmp_path, max_queue=1))
+    from rustpde_mpi_tpu.serve.http_front import HttpFront
+
+    front = HttpFront(srv)
+    front.start()
+    try:
+        host, port = front.address
+        base = f"http://{host}:{port}"
+
+        def post(payload):
+            req = urllib.request.Request(
+                base + "/requests",
+                data=json.dumps(payload).encode(),
+                method="POST",
+            )
+            try:
+                with urllib.request.urlopen(req, timeout=30) as resp:
+                    return resp.status, json.loads(resp.read()), dict(resp.headers)
+            except urllib.error.HTTPError as err:
+                return err.code, json.loads(err.read()), dict(err.headers)
+
+        assert post(dict(_REQ, seed=0))[0] == 202
+        code, body, headers = post(dict(_REQ, seed=1))
+        assert code == 429
+        assert body["reason"] == "queue_full"
+        assert body["queue_depth"] == 1
+        assert body["retry_after_s"] >= 1
+        assert int(headers["Retry-After"]) == body["retry_after_s"]
+    finally:
+        front.stop()
+
+
+# -- fleet-mode serving (single replica, in process) --------------------------
+
+
+def test_fleet_replica_serves_with_leases(tmp_path):
+    """One fleet-mode replica end-to-end: buckets claimed through leases
+    (journaled lease_claimed/lease_released in the replica's own journal
+    under replicas/<id>/), every request resolves, results match solo."""
+    fleet = FleetConfig(replica_id="rA", lease_ttl_s=30.0)
+    srv = SimServer(_cfg(tmp_path, fleet=fleet))
+    ids = [srv.submit(dict(_REQ, seed=s)).id for s in range(3)]
+    summary = srv.serve()
+    assert summary["completed"] == 3 and summary["failed"] == 0
+    assert summary["fleet"]["replica"] == "rA"
+    events = _replica_events(str(tmp_path / "fleet"), "rA")
+    names = [e["event"] for e in events]
+    assert "lease_claimed" in names and "lease_released" in names
+    # no lease files left behind after the clean release
+    leases = os.listdir(os.path.join(str(tmp_path / "fleet"), "queue", "leases"))
+    assert [n for n in leases if n.endswith(".json")] == []
+    for rid in ids:
+        res = srv.result(rid)
+        m = Navier2D(17, 17, 1e4, 1.0, res["dt"], 1.0, "rbc", periodic=False)
+        m.init_random(res["amp"], seed=res["seed"])
+        m.update_n(res["steps"])
+        assert res["nu"] == pytest.approx(float(m.eval_nu()), rel=1e-9)
+
+
+def test_fleet_preemption_is_loss_free(tmp_path):
+    """The QoS acceptance path in process: a best-effort request is
+    mid-campaign when an interactive one with a deadline arrives — the
+    lane is preempted (requeue-with-state, durably parked), the
+    interactive request runs and finishes FIRST, and the preempted
+    request still completes solo-equivalent."""
+    fleet = FleetConfig(
+        replica_id="rA", lease_ttl_s=60.0, preempt_slack_s=3600.0
+    )
+    srv = SimServer(_cfg(tmp_path, slots=1, fleet=fleet))
+    be = srv.submit(dict(_REQ, seed=0, horizon=1.0, priority="best-effort"))
+    box = {}
+
+    def later():
+        while srv.stats()["member_steps"] < 8:
+            time.sleep(0.05)
+        box["ia"] = srv.submit(
+            dict(_REQ, seed=1, horizon=0.05, priority="interactive",
+                 deadline_s=30.0)
+        )
+
+    t = threading.Thread(target=later)
+    t.start()
+    summary = srv.serve()
+    t.join()
+    ia = box["ia"]
+    assert summary["completed"] == 2 and summary["failed"] == 0
+    assert summary["fleet"]["preempted"] >= 1
+    events = _replica_events(str(tmp_path / "fleet"), "rA")
+    names = [e["event"] for e in events]
+    assert "request_preempted" in names
+    assert "continuation_persisted" in names
+    pre = [e for e in events if e["event"] == "request_preempted"]
+    assert pre[0]["id"] == be.id and pre[0]["preempted_for"] == ia.id
+    assert pre[0]["steps_done"] > 0
+    # the interactive request met its deadline and finished FIRST
+    done = [e for e in events if e["event"] == "request_done"]
+    assert done[0]["id"] == ia.id
+    ia_res = srv.result(ia.id)
+    assert ia_res["admission_to_first_observable_s"] < 30.0
+    # the preempted request resumed mid-flight and stayed solo-equivalent
+    sched = [
+        e for e in events
+        if e["event"] == "request_scheduled" and e.get("parked")
+    ]
+    assert sched and sched[0]["base"] > 0
+    res = srv.result(be.id)
+    m = Navier2D(17, 17, 1e4, 1.0, res["dt"], 1.0, "rbc", periodic=False)
+    m.init_random(res["amp"], seed=0)
+    m.update_n(res["steps"])
+    assert res["nu"] == pytest.approx(float(m.eval_nu()), rel=1e-9)
+
+
+def test_fleet_cross_bucket_preemption_drains_campaign(tmp_path):
+    """Cross-bucket preemption must CLOSE the running campaign's claims:
+    the parked best-effort victim lands back in the same bucket's queue,
+    and an open refill would re-claim it at the same boundary forever.
+    With the claims closed the campaign drains, the QoS-ordered pick
+    takes the interactive bucket, and the victim still completes."""
+    fleet = FleetConfig(
+        replica_id="rA", lease_ttl_s=60.0, preempt_slack_s=3600.0
+    )
+    srv = SimServer(_cfg(tmp_path, slots=1, fleet=fleet))
+    be = srv.submit(dict(_REQ, seed=0, horizon=1.0, priority="best-effort"))
+    box = {}
+
+    def later():
+        while srv.stats()["member_steps"] < 8:
+            time.sleep(0.05)
+        # DIFFERENT bucket (dt differs): the cross-bucket emergency
+        box["ia"] = srv.submit(
+            dict(_REQ, dt=0.005, seed=1, horizon=0.05,
+                 priority="interactive", deadline_s=60.0)
+        )
+
+    t = threading.Thread(target=later)
+    t.start()
+    summary = srv.serve()
+    t.join()
+    ia = box["ia"]
+    assert summary["completed"] == 2 and summary["failed"] == 0
+    assert summary["fleet"]["preempted"] >= 1
+    events = _replica_events(str(tmp_path / "fleet"), "rA")
+    pre = [e for e in events if e["event"] == "request_preempted"]
+    assert pre and pre[0]["id"] == be.id and pre[0]["preempted_for"] == ia.id
+    # the interactive (other-bucket) request finished before the victim
+    done = [e for e in events if e["event"] == "request_done"]
+    assert done[0]["id"] == ia.id
+    # ... and the victim was NOT re-claimed in the preempting campaign:
+    # exactly one preemption, no park/requeue churn
+    assert len(pre) == 1
+    res = srv.result(be.id)
+    m = Navier2D(17, 17, 1e4, 1.0, res["dt"], 1.0, "rbc", periodic=False)
+    m.init_random(res["amp"], seed=0)
+    m.update_n(res["steps"])
+    assert res["nu"] == pytest.approx(float(m.eval_nu()), rel=1e-9)
+
+
+def test_fleet_resumes_peer_continuation_mid_flight(tmp_path):
+    """Cross-replica continuation: a (dead) peer's durable park is
+    re-claimed by a fresh replica, which resumes MID-FLIGHT (journaled
+    continuation_resumed, steps > 0) and lands bit-close to the solo
+    trajectory — the zero-lost acceptance shape without subprocesses."""
+    run_dir = str(tmp_path / "fleet")
+    m = Navier2D(17, 17, 1e4, 1.0, 0.01, 1.0, "rbc", periodic=False)
+    m.init_random(0.1, seed=3)
+    m.update_n(5)
+    req = SimRequest(**_REQ, seed=3, amp=0.1)
+    cdir = checkpoint.continuation_dir(run_dir, req.id)
+    checkpoint.write_continuation(cdir, m.state, base=5, time_base=0.05)
+    fleet = FleetConfig(replica_id="rB", lease_ttl_s=30.0)
+    srv = SimServer(_cfg(tmp_path, fleet=fleet))
+    import dataclasses
+
+    srv.queue.submit(dataclasses.replace(req, progress=5))
+    summary = srv.serve()
+    assert summary["completed"] == 1 and summary["failed"] == 0
+    events = _replica_events(run_dir, "rB")
+    resumed = [e for e in events if e["event"] == "continuation_resumed"]
+    assert resumed and resumed[0]["steps"] == 5
+    res = srv.result(req.id)
+    assert res["steps"] == 10
+    solo = Navier2D(17, 17, 1e4, 1.0, 0.01, 1.0, "rbc", periodic=False)
+    solo.init_random(0.1, seed=3)
+    solo.update_n(10)
+    assert res["nu"] == pytest.approx(float(solo.eval_nu()), rel=1e-9)
+    # consumed: the continuation dir was retired at completion
+    assert not checkpoint.continuation_exists(cdir)
+
+
+def test_fleet_breaks_dead_replica_lease_and_reclaims(tmp_path):
+    """Failure detection in process: a 'dead' replica left a lease + a
+    claimed (running/) request behind.  A live replica's sweep breaks the
+    stale lease, re-enqueues exactly that bucket's requests, and serves
+    them — journaled lease_broken + requests_reclaimed."""
+    run_dir = str(tmp_path / "fleet")
+    lease_root = os.path.join(run_dir, "queue", "leases")
+    dead = LeaseManager(lease_root, "dead-replica", ttl_s=0.2)
+    queue = DurableQueue(os.path.join(run_dir, "queue"), max_queue=8)
+    req = queue.submit(SimRequest(**_REQ, seed=0))
+    assert queue.claim().id == req.id  # the dead replica had claimed it
+    dead.claim(_KEY)
+    time.sleep(0.5)  # stale past the TTL
+    fleet = FleetConfig(replica_id="live", lease_ttl_s=0.2)
+    srv = SimServer(_cfg(tmp_path, fleet=fleet))
+    summary = srv.serve()
+    assert summary["completed"] == 1 and summary["failed"] == 0
+    assert summary["fleet"]["leases_broken"] == 1
+    events = _replica_events(run_dir, "live")
+    names = [e["event"] for e in events]
+    assert "lease_broken" in names
+    reclaimed = [e for e in events if e["event"] == "requests_reclaimed"]
+    assert reclaimed and reclaimed[0]["ids"] == [req.id]
+
+
+def test_fleet_off_adds_zero_journal_rows(tmp_path):
+    """The acceptance invariant: with fleet=None the lease/continuation/
+    QoS machinery contributes NOTHING — no fleet journal rows, no
+    replicas/ or leases/ or parked/ dirs, the single-replica layout
+    byte-identical to PR 10's."""
+    srv = SimServer(_cfg(tmp_path))
+    srv.submit(dict(_REQ, seed=0))
+    summary = srv.serve()
+    assert summary["completed"] == 1
+    assert "fleet" not in summary
+    run_dir = str(tmp_path / "fleet")
+    events = read_journal(os.path.join(run_dir, "journal.jsonl"))
+    fleet_rows = [
+        e for e in events
+        if e["event"].startswith(("lease_", "continuation_", "quota_"))
+        or e["event"] in ("request_preempted", "requests_reclaimed",
+                          "campaign_fenced")
+    ]
+    assert fleet_rows == []
+    assert not os.path.exists(os.path.join(run_dir, "replicas"))
+    assert not os.path.exists(os.path.join(run_dir, "parked"))
+    assert not os.path.exists(os.path.join(run_dir, "queue", "leases"))
+
+
+# -- the multi-replica chaos soak (slow tier) ---------------------------------
+
+
+def _spawn_fleet_proc(run_dir, args, log_path):
+    env = dict(os.environ, JAX_PLATFORMS="cpu", RUSTPDE_X64="1")
+    env.pop("RUSTPDE_FAULT", None)
+    log = open(log_path, "w")
+    return subprocess.Popen(
+        [
+            sys.executable,
+            os.path.join(_REPO, "examples", "navier_rbc_fleet.py"),
+            "--run-dir", run_dir, *args,
+        ],
+        stdout=log, stderr=subprocess.STDOUT, text=True, env=env, cwd=_REPO,
+    ), log
+
+
+@pytest.mark.slow
+def test_fleet_chaos_soak_replica_sigkill(tmp_path):
+    """The acceptance gate: 1 proxy + 2 replicas over one shared queue,
+    mixed-priority traffic submitted through the proxy, one replica
+    SIGKILLed mid-campaign while it holds leases and durable parked
+    continuations -> ZERO requests lost, the survivor breaks the dead
+    replica's lease (journaled lease_broken) and resumes its requests
+    MID-TRAJECTORY from the durable parked state (continuation_resumed,
+    steps > 0), and a resumed request's result matches the solo rerun to
+    rtol 1e-9."""
+    run_dir = str(tmp_path / "fleet")
+    os.makedirs(run_dir, exist_ok=True)
+    procs, logs = [], []
+
+    def spawn(args, name):
+        p, log = _spawn_fleet_proc(
+            run_dir, args, os.path.join(run_dir, f"{name}.log")
+        )
+        procs.append(p)
+        logs.append(log)
+        return p
+
+    try:
+        proxy = spawn(["--proxy", "--lease-ttl-s", "3"], "proxy")
+        addr = None
+        deadline = time.time() + 120
+        while time.time() < deadline and addr is None:
+            time.sleep(0.2)
+            try:
+                with open(os.path.join(run_dir, "proxy.log")) as fh:
+                    for line in fh:
+                        if line.startswith("{"):
+                            addr = json.loads(line)["address"]
+                            break
+            except OSError:
+                pass
+        assert addr, "proxy never bound"
+        base = f"http://{addr[0]}:{addr[1]}"
+        common = [
+            "--replica", "--daemon", "--lease-ttl-s", "3",
+            "--heartbeat-s", "0.2", "--slots", "2", "--chunk-steps", "8",
+            "--ckpt-every-s", "1000",
+        ]
+        rA = spawn([*common, "--replica-id", "rA"], "rA")
+        rB = spawn([*common, "--replica-id", "rB"], "rB")
+
+        def post(payload):
+            req = urllib.request.Request(
+                base + "/requests",
+                data=json.dumps(payload).encode(),
+                method="POST",
+            )
+            with urllib.request.urlopen(req, timeout=30) as resp:
+                return resp.status, json.loads(resp.read())
+
+        n_req = 8
+        ids = []
+        for seed in range(n_req):
+            pri = "best-effort" if seed % 2 else "batch"
+            code, ack = post(
+                dict(_REQ, seed=seed, horizon=2.0 + 0.08 * seed,
+                     priority=pri, tenant=f"t{seed % 2}")
+            )
+            assert code == 202
+            ids.append(ack["id"])
+
+        # kill whichever replica persisted a mid-flight continuation first
+        def persisted(rid):
+            try:
+                return any(
+                    e["event"] == "continuation_persisted"
+                    and e.get("steps", 0) > 0
+                    for e in _replica_events(run_dir, rid)
+                )
+            except Exception:
+                return False
+
+        victim = None
+        deadline = time.time() + 600
+        while time.time() < deadline and victim is None:
+            time.sleep(0.2)
+            for rid in ("rA", "rB"):
+                if persisted(rid):
+                    victim = rid
+                    break
+        assert victim, "no mid-flight continuation ever persisted"
+        vic, sur = (rA, "rB") if victim == "rA" else (rB, "rA")
+        vic.send_signal(signal.SIGKILL)
+
+        # the fleet drains everything: zero lost, zero failed
+        queue = DurableQueue(os.path.join(run_dir, "queue"), max_queue=512)
+        deadline = time.time() + 900
+        while time.time() < deadline:
+            counts = queue.counts()
+            if counts["done"] == n_req and counts["queued"] == 0 \
+                    and counts["running"] == 0:
+                break
+            time.sleep(0.5)
+        assert counts == {
+            "queued": 0, "running": 0, "done": n_req, "failed": 0
+        }, counts
+
+        # graceful teardown of the survivors
+        sur_proc = rB if victim == "rA" else rA
+        sur_proc.send_signal(signal.SIGTERM)
+        sur_proc.wait(timeout=300)
+        proxy.send_signal(signal.SIGTERM)
+        proxy.wait(timeout=60)
+
+        events = _replica_events(run_dir, sur)
+        names = [e["event"] for e in events]
+        assert "lease_broken" in names
+        assert "requests_reclaimed" in names
+        resumed = [
+            e for e in events
+            if e["event"] == "continuation_resumed" and e.get("steps", 0) > 0
+        ]
+        assert resumed, "survivor never resumed mid-flight from durable state"
+        # lease-break-to-reclaim is prompt (well under one TTL)
+        breaks = [e for e in events if e["event"] == "lease_broken"]
+        claims = [
+            e for e in events
+            if e["event"] == "lease_claimed" and e["t"] > breaks[0]["t"]
+        ]
+        assert claims and claims[0]["t"] - breaks[0]["t"] < 3.0
+
+        # solo-equivalence of a mid-flight-resumed request
+        rid = resumed[0]["id"]
+        with open(os.path.join(run_dir, "queue", "done", f"{rid}.json")) as fh:
+            res = json.load(fh)["result"]
+        m = Navier2D(17, 17, 1e4, 1.0, res["dt"], 1.0, "rbc", periodic=False)
+        m.init_random(res["amp"], seed=res["seed"])
+        m.update_n(res["steps"])
+        assert res["nu"] == pytest.approx(float(m.eval_nu()), rel=1e-9)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        for log in logs:
+            log.close()

@@ -57,6 +57,7 @@ MULTIHOST_MODULES = (
 DURABLE_MODULES = (
     "rustpde_mpi_tpu/utils/checkpoint.py",
     "rustpde_mpi_tpu/serve/queue.py",
+    "rustpde_mpi_tpu/serve/fleet/",  # leases, heartbeats, continuations
     "rustpde_mpi_tpu/utils/journal.py",
     "rustpde_mpi_tpu/utils/io_pipeline.py",
     "rustpde_mpi_tpu/utils/slice_io.py",
@@ -457,7 +458,8 @@ def rule_asarray_on_sharded(module) -> list:
         "rustpde_mpi_tpu/utils/checkpoint.py",
         "rustpde_mpi_tpu/utils/resilience.py",
         "rustpde_mpi_tpu/utils/io_pipeline.py",
-        "rustpde_mpi_tpu/serve/",
+        "rustpde_mpi_tpu/serve/",  # the serve/ prefix covers serve/fleet/
+        "rustpde_mpi_tpu/serve/fleet/",  # explicit: day-one durability scope
         "rustpde_mpi_tpu/models/campaign.py",
     )
     if not _in(module.relpath, scope):
