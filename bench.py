@@ -76,6 +76,7 @@ DEFAULT_CONFIGS = [
     "workloads129",
     "stats129",
     "pallasconv",
+    "bandedsolve",
     "periodic",
     "poisson1025",
     "poisson1025_f64",
@@ -104,7 +105,8 @@ METRIC_NAMES = {
     "serve129": "2D RBC simulation service 129x129 Ra=1e7, 200 requests / 8 slots soak (drain+NaN chaos; member-steps/s + latency percentiles)",
     "workloads129": "multi-model workloads 129x129 (dns/lnse/adjoint member-steps/s per kind + solo-vs-ensemble parity + lnse onset-sign gate)",
     "stats129": "2D RBC confined 129x129 Ra=1e7 in-scan physics stats (stats-on vs stats-off matched governed windows: bit-equal trajectory + <=5% overhead + budget-closure gates)",
-    "pallasconv": "fused Pallas convection chain vs unfused dense (RUSTPDE_CONV_KERNEL A/B: ms/step + MFU + bit-tolerance deltas; 129x129 min, flagship rows on-chip)",
+    "pallasconv": "fused Pallas convection + solve megakernels vs unfused dense (RUSTPDE_CONV_KERNEL / RUSTPDE_STEP_KERNEL A/B: ms/step + MFU + bit-tolerance + HBM-traffic deltas; 129x129 min, flagship rows on-chip)",
+    "bandedsolve": "lane-parallel Pallas banded substitution vs dense-inverse GEMM vs lax.scan recurrence (ops/pallas_banded.bench_banded_paths: sec/solve per path at 1023x1025)",
     "periodic": "2D RBC periodic 128x65 Ra=1e6",
     "periodic1024": "2D RBC periodic 1024x1025 Ra=1e9",
     "poisson1025": "Poisson standalone 1025x1025",
@@ -1470,7 +1472,10 @@ def bench_serve(nx=129, ny=129, ra=1e7, dt=2e-3, steps_per_req=8):
 def bench_pallasconv(steps=8):
     """Fused Pallas convection chain vs the unfused dense chain
     (RUSTPDE_CONV_KERNEL knob, ops/pallas_conv.py): ms/step, MFU and
-    bit-tolerance deltas per grid.
+    bit-tolerance deltas per grid.  The ``stepkernel`` leg runs the same
+    A/B for the implicit half (RUSTPDE_STEP_KERNEL, ops/pallas_step.py:
+    fused Helmholtz/Poisson solves + projection) and records the analytic
+    HBM-bytes-per-step estimate both ways.
 
     Off-TPU the kernel runs in interpreter mode, so the ms/step numbers
     measure plumbing, not the chip — the honest speed A/B lands when a TPU
@@ -1496,6 +1501,7 @@ def bench_pallasconv(steps=8):
         ]
     parity_tol = 1e-10 if config.X64 else 1e-3
     prev_knob = os.environ.get("RUSTPDE_CONV_KERNEL")
+    prev_step = os.environ.get("RUSTPDE_STEP_KERNEL")
     res = {"configs": {}, "interpret_mode": not on_chip, "parity_tol": parity_tol}
     ok = True
     try:
@@ -1559,11 +1565,82 @@ def bench_pallasconv(steps=8):
             )
             ok = ok and row["parity_ok"] and row["recompile_flat"]
             res["configs"][name] = row
+
+        # -- stepkernel leg: fused Helmholtz/Poisson solves + projection
+        # (RUSTPDE_STEP_KERNEL, ops/pallas_step.py) vs the dense solver
+        # chain — the implicit half of the step joining the fused path.
+        # Same gates as the conv leg (parity floored by the physical field
+        # scale, recompile_count flat across live-model knob flips), plus
+        # the analytic HBM-bytes-per-step estimate both ways (the quantity
+        # the megakernel exists to shrink; BASELINE.md traffic table).
+        from rustpde_mpi_tpu.ops.pallas_step import step_traffic_estimate
+
+        os.environ["RUSTPDE_CONV_KERNEL"] = "dense"  # isolate the step knob
+        res["stepkernel"] = {}
+        for name, c in cases:
+            ctor = Navier2D.new_periodic if c["periodic"] else Navier2D.new_confined
+
+            def build(kernel, c=c, ctor=ctor):
+                os.environ["RUSTPDE_STEP_KERNEL"] = kernel
+                m = ctor(c["nx"], c["ny"], c["ra"], 1.0, c["dt"], 1.0, "rbc")
+                m.set_velocity(0.1, 2.0, 2.0)
+                m.set_temperature(0.1, 2.0, 2.0)
+                return m
+
+            row = {}
+            for kernel in ("dense", "pallas"):
+                m = build(kernel)
+                if kernel == "pallas":
+                    if m._step_impl is None:
+                        raise RuntimeError("pallas step kernels were not selected")
+                    live_pallas = m
+                    row["hbm_traffic"] = step_traffic_estimate(m)
+                r = benchmark_steps(m, steps)
+                row[kernel] = {
+                    "ms_per_step": r["ms_per_step"],
+                    "steps_per_sec": r["steps_per_sec"],
+                    "mfu": mfu_estimate(m, r["steps_per_sec"])["mfu"],
+                }
+            row["speedup_x"] = (
+                row["dense"]["ms_per_step"] / row["pallas"]["ms_per_step"]
+            )
+            d2, p2 = build("dense"), build("pallas")
+            d2.update_n(8)
+            p2.update_n(8)
+            field_scale = max(
+                float(np.abs(np.asarray(b)).max())
+                for b in (d2.state.temp, d2.state.velx, d2.state.vely)
+            )
+            rel = 0.0
+            for a, b in zip(p2.state, d2.state):
+                a, b = np.asarray(a), np.asarray(b)
+                scale = max(float(np.abs(b).max()), field_scale, 1e-30)
+                rel = max(rel, float(np.abs(a - b).max() / scale))
+            row["parity_max_rel"] = rel
+            nu_d, nu_p = d2.eval_nu(), p2.eval_nu()
+            row["nu_rel"] = abs(nu_p - nu_d) / max(1e-12, abs(nu_d))
+            row["parity_ok"] = bool(
+                rel < parity_tol and row["nu_rel"] < parity_tol
+            )
+            os.environ["RUSTPDE_STEP_KERNEL"] = "dense"
+            before = (live_pallas.recompile_count, d2.recompile_count)
+            live_pallas.update_n(4)
+            os.environ["RUSTPDE_STEP_KERNEL"] = "pallas"
+            d2.update_n(4)
+            row["recompile_flat"] = bool(
+                (live_pallas.recompile_count, d2.recompile_count) == before
+            )
+            ok = ok and row["parity_ok"] and row["recompile_flat"]
+            res["stepkernel"][name] = row
     finally:
-        if prev_knob is None:
-            os.environ.pop("RUSTPDE_CONV_KERNEL", None)
-        else:
-            os.environ["RUSTPDE_CONV_KERNEL"] = prev_knob
+        for knob, prev in (
+            ("RUSTPDE_CONV_KERNEL", prev_knob),
+            ("RUSTPDE_STEP_KERNEL", prev_step),
+        ):
+            if prev is None:
+                os.environ.pop(knob, None)
+            else:
+                os.environ[knob] = prev
     head = res["configs"]["rbc129"]
     res["steps_per_sec"] = head["pallas"]["steps_per_sec"]
     res["ms_per_step"] = head["pallas"]["ms_per_step"]
@@ -1572,8 +1649,42 @@ def bench_pallasconv(steps=8):
     res["parity_max_rel"] = max(
         r["parity_max_rel"] for r in res["configs"].values()
     )
+    sk = res["stepkernel"]["rbc129"]
+    res["stepkernel_speedup_x"] = sk["speedup_x"]
+    res["stepkernel_parity_max_rel"] = max(
+        r["parity_max_rel"] for r in res["stepkernel"].values()
+    )
+    res["hbm_traffic_ratio"] = sk["hbm_traffic"]["traffic_ratio"]
     res["finite"] = bool(ok)
     return res
+
+
+def bench_bandedsolve(repeats=None):
+    """Banded-substitution micro-bench (ops/pallas_banded.bench_banded_paths,
+    referenced by the module docstring and solver.py but previously not in
+    the driver): sec/solve for the lane-parallel Pallas recurrence vs the
+    dense-inverse GEMM vs the lax.scan substitution at the ADI solver's
+    flagship shape (1023 rows x 1025 lanes).  Off-TPU the Pallas path runs
+    in interpreter mode, so the recorded row keeps BASELINE.md's
+    dense-inverse-vs-recurrence crossover claim reproducible per PR; the
+    chip-honest crossover lands with the on-chip capture."""
+    import jax
+
+    from rustpde_mpi_tpu.ops.pallas_banded import bench_banded_paths
+
+    on_chip = jax.devices()[0].platform in ("tpu", "axon")
+    if repeats is None:
+        repeats = 50 if on_chip else 5
+    r = bench_banded_paths(repeats=repeats)
+    return {
+        "sec_per_solve": r,
+        "solves_per_sec": 1.0 / r["dense_gemm"],
+        "pallas_vs_dense_x": r["dense_gemm"] / r["pallas"],
+        "scan_vs_dense_x": r["dense_gemm"] / r["banded_scan"],
+        "interpret_mode": not on_chip,
+        "repeats": repeats,
+        "finite": all(v > 0.0 and v == v for v in r.values()),
+    }
 
 
 def bench_resilience(nx, ny, ra, dt, steps):
@@ -2036,6 +2147,10 @@ def main() -> int:
                 # fused-vs-dense convection A/B: parity + recompile gates
                 # everywhere, speed/MFU deltas honest only on-chip
                 r = bench_pallasconv(steps=max(8, min(steps, 16)))
+            elif name == "bandedsolve":
+                # banded-path micro-bench: sec/solve per path at the ADI
+                # solver's flagship shape (crossover claim, BASELINE.md)
+                r = bench_bandedsolve()
             elif name == "stats129":
                 # matched governed windows, stats-on vs stats-off; the
                 # window is capped so the doubled run fits the budget

@@ -104,6 +104,8 @@ register_knob("RUSTPDE_FAST_DERIV", "auto", "banded fast-derivative mode")
 register_knob("RUSTPDE_FAST_DERIV_MIN", "2048", "fast-derivative min size")
 register_knob("RUSTPDE_CONV_KERNEL", "dense",
               "convection chain: dense per-GEMM chain | pallas fused kernel")
+register_knob("RUSTPDE_STEP_KERNEL", "dense",
+              "implicit-solve stages: dense solver chain | pallas fused megakernel")
 register_knob("RUSTPDE_PALLAS_CONV_BLOCK", "256",
               "pallas conv kernel physical-x tile")
 register_knob("RUSTPDE_PALLAS_CONV_BLOCK_K", "512",

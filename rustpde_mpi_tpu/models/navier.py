@@ -270,6 +270,13 @@ class Navier2D(CampaignModelBase, Integrate):
         with self._scope():
             self._build_bc_fields(xs, ys)
 
+        # fused implicit-half stage kernels (RUSTPDE_STEP_KERNEL=pallas,
+        # ops/pallas_step.py): Helmholtz/Poisson solves + divergence +
+        # projection as VMEM-resident Pallas stages; None keeps the dense
+        # solver chain (the measured default).  Built AFTER the BC fields
+        # (the buoyancy/diffusion lift constants fold into the stages).
+        self._step_impl = self._build_step_kernels()
+
         # jitted step + observables
         # jit with closure-converted constants: the dense transform / solver
         # matrices are hoisted out of the traced program and passed as
@@ -341,6 +348,19 @@ class Navier2D(CampaignModelBase, Integrate):
         if pallas_conv.conv_kernel_choice() != "pallas":
             return None
         return pallas_conv.build_model_convs(self)
+
+    def _build_step_kernels(self):
+        """Fused implicit-half stage kernels the step routes through
+        (None: the dense solver chain).  Single-device only — meshed
+        models keep the dense/manual-shard_map paths; the sharded fused
+        stages ride the shard_map follow-up (ROADMAP)."""
+        from ..ops import pallas_step
+
+        if self.mesh is not None:
+            return None
+        if pallas_step.step_kernel_choice() != "pallas":
+            return None
+        return pallas_step.build_model_step(self)
 
     def _split_sep_poisoned(self) -> bool:
         """The layout the upstream GSPMD bug miscompiles: split Re/Im
@@ -453,6 +473,9 @@ class Navier2D(CampaignModelBase, Integrate):
         self._scenario = scenario
         self._dt_cache.clear()
         self.solver_scal = self._build_scalar_solver()
+        # scenario terms (Coriolis cross-coupling, the scalar stage) are
+        # baked into the fused stage kernels — rebuild alongside the solver
+        self._step_impl = self._build_step_kernels()
         want_scal = self._scalar_active()
         have_scal = hasattr(self.state, "scal")
         if want_scal and not have_scal:
@@ -777,6 +800,7 @@ class Navier2D(CampaignModelBase, Integrate):
             return contextlib.nullcontext()
 
         conv_impl = self._conv_impl
+        step_impl = self._step_impl
         manual_synth = getattr(self, "_manual_synth", None)
         manual_poisson = getattr(self, "_manual_poisson", None)
 
@@ -853,72 +877,106 @@ class Navier2D(CampaignModelBase, Integrate):
                 )
                 ke = 0.5 * jnp.sum((ux**2 + uy**2) * w0s[:, None] * w1s[None, :])
 
-            # horizontal momentum (navier_eq.rs:176-187)
-            rhs = sp_u.to_ortho(velx)
-            rhs = rhs - dt * sp_p.gradient(pres, (1, 0), scale)
-            rhs = rhs - dt * conv(ux, uy, sp_u, velx)
-            if coriolis:
-                # rotating-frame f-plane term +f*v (velx/vely share one
-                # space, so the cross-coupling is a plain ortho-space add);
-                # in exactly incompressible 2-D flow this force is
-                # irrotational and absorbed by the pressure — the scenario's
-                # analytic validation case (tests/test_workloads.py)
-                rhs = rhs + dt * coriolis * sp_v.to_ortho(vely)
-            with solve_scope():
-                velx_n = sol_u.solve(pin(rhs))
-
-            # vertical momentum + buoyancy (navier_eq.rs:190-203)
-            rhs = sp_v.to_ortho(vely)
-            rhs = rhs - dt * sp_p.gradient(pres, (0, 1), scale)
-            rhs = rhs + dt * that
-            rhs = rhs - dt * conv(ux, uy, sp_v, vely)
-            if coriolis:
-                rhs = rhs - dt * coriolis * sp_u.to_ortho(velx)
-            with solve_scope():
-                vely_n = sol_v.solve(pin(rhs))
-
-            # pressure projection (navier_eq.rs:19-25,117-125,137-143,158-162)
-            div = sp_u.gradient(velx_n, (1, 0), scale) + sp_v.gradient(
-                vely_n, (0, 1), scale
-            )
-            with solve_scope():
-                if manual_poisson is not None:
-                    # the manually-partitioned fast-diag region — the one
-                    # stage whose GSPMD fusion miscompiles on the split-sep
-                    # layout (parallel/decomp.ShardedPoisson bisection)
-                    pseu_n = manual_poisson.solve(div)
-                else:
-                    pseu_n = sol_p.solve(pin(div))
-            pseu_n = sp_q.pin_zero_mode(pseu_n)  # remove singularity
-            if proj_grad is not None:
-                gx0, gx1, gy0, gy1 = proj_grad
-                ax = pseu_n.ndim - 2
-                velx_n = velx_n - gx1.apply(gx0.apply(pseu_n, ax), ax + 1) / scale[0]
-                vely_n = vely_n - gy1.apply(gy0.apply(pseu_n, ax), ax + 1) / scale[1]
+            if step_impl is not None:
+                # fused implicit half (ops/pallas_step.py): each stage ONE
+                # Pallas kernel — rhs terms with the Helmholtz inverse
+                # folded in for the velocities/temperature, divergence ->
+                # fast-diag Poisson (singular pin in the epilogue mask) ->
+                # pressure-gradient projection.  The convection chain feeds
+                # the stages unchanged (dense or FusedConv per
+                # RUSTPDE_CONV_KERNEL); the stage dots pin HIGHEST matmul
+                # precision themselves, so no solve_scope here.  Mesh-free
+                # by construction (_build_step_kernels), hence no pins.
+                cx = conv(ux, uy, sp_u, velx)
+                args = (velx, pres, cx) + ((vely,) if coriolis else ())
+                velx_n = step_impl["velx"].apply(*args)
+                cy = conv(ux, uy, sp_v, vely)
+                args = (vely, pres, temp, cy) + ((velx,) if coriolis else ())
+                vely_n = step_impl["vely"].apply(*args)
+                div = step_impl["div"].apply(velx_n, vely_n)
+                pseu_n = sp_q.pin_zero_mode(step_impl["poisson"].apply(div))
+                velx_n = velx_n - step_impl["projx"].apply(pseu_n)
+                vely_n = vely_n - step_impl["projy"].apply(pseu_n)
+                pres_n = pres - nu * div + sp_q.to_ortho(pseu_n) / dt
+                ct = conv(ux, uy, sp_t, temp, with_bc=True)
+                temp_n = step_impl["temp"].apply(temp, ct)
+                if has_scal:
+                    cs = conv(ux, uy, sp_t, state.scal, with_bc=True)
+                    scal_n = step_impl["scal"].apply(state.scal, cs)
             else:
-                velx_n = velx_n - sp_u.from_ortho(sp_q.gradient(pseu_n, (1, 0), scale))
-                vely_n = vely_n - sp_v.from_ortho(sp_q.gradient(pseu_n, (0, 1), scale))
-            pres_n = pres - nu * div + sp_q.to_ortho(pseu_n) / dt
-
-            # temperature (navier_eq.rs:209-224)
-            rhs = sp_t.to_ortho(temp)
-            rhs = rhs + tb_diff
-            rhs = rhs - dt * conv(ux, uy, sp_t, temp, with_bc=True)
-            with solve_scope():
-                temp_n = sol_t.solve(pin(rhs))
-
-            if has_scal:
-                # passive scalar (scenario modifier): the temperature's
-                # advection-diffusion at the scalar diffusivity, same BC
-                # lift — with matched diffusivity a scalar released equal
-                # to the temperature stays identically equal (exact
-                # validation case); the buoyancy never reads it (one-way
-                # coupling, hence "passive")
-                rhs = sp_t.to_ortho(state.scal)
-                rhs = rhs + kc_over_ka * tb_diff  # dt*kc*lap(bc lift)
-                rhs = rhs - dt * conv(ux, uy, sp_t, state.scal, with_bc=True)
+                # horizontal momentum (navier_eq.rs:176-187)
+                rhs = sp_u.to_ortho(velx)
+                rhs = rhs - dt * sp_p.gradient(pres, (1, 0), scale)
+                rhs = rhs - dt * conv(ux, uy, sp_u, velx)
+                if coriolis:
+                    # rotating-frame f-plane term +f*v (velx/vely share one
+                    # space, so the cross-coupling is a plain ortho-space
+                    # add); in exactly incompressible 2-D flow this force is
+                    # irrotational and absorbed by the pressure — the
+                    # scenario's analytic validation case
+                    # (tests/test_workloads.py)
+                    rhs = rhs + dt * coriolis * sp_v.to_ortho(vely)
                 with solve_scope():
-                    scal_n = sol_c.solve(pin(rhs))
+                    velx_n = sol_u.solve(pin(rhs))
+
+                # vertical momentum + buoyancy (navier_eq.rs:190-203)
+                rhs = sp_v.to_ortho(vely)
+                rhs = rhs - dt * sp_p.gradient(pres, (0, 1), scale)
+                rhs = rhs + dt * that
+                rhs = rhs - dt * conv(ux, uy, sp_v, vely)
+                if coriolis:
+                    rhs = rhs - dt * coriolis * sp_u.to_ortho(velx)
+                with solve_scope():
+                    vely_n = sol_v.solve(pin(rhs))
+
+                # pressure projection
+                # (navier_eq.rs:19-25,117-125,137-143,158-162)
+                div = sp_u.gradient(velx_n, (1, 0), scale) + sp_v.gradient(
+                    vely_n, (0, 1), scale
+                )
+                with solve_scope():
+                    if manual_poisson is not None:
+                        # the manually-partitioned fast-diag region — the
+                        # one stage whose GSPMD fusion miscompiles on the
+                        # split-sep layout (parallel/decomp.ShardedPoisson
+                        # bisection)
+                        pseu_n = manual_poisson.solve(div)
+                    else:
+                        pseu_n = sol_p.solve(pin(div))
+                pseu_n = sp_q.pin_zero_mode(pseu_n)  # remove singularity
+                if proj_grad is not None:
+                    gx0, gx1, gy0, gy1 = proj_grad
+                    ax = pseu_n.ndim - 2
+                    velx_n = velx_n - gx1.apply(gx0.apply(pseu_n, ax), ax + 1) / scale[0]
+                    vely_n = vely_n - gy1.apply(gy0.apply(pseu_n, ax), ax + 1) / scale[1]
+                else:
+                    velx_n = velx_n - sp_u.from_ortho(
+                        sp_q.gradient(pseu_n, (1, 0), scale)
+                    )
+                    vely_n = vely_n - sp_v.from_ortho(
+                        sp_q.gradient(pseu_n, (0, 1), scale)
+                    )
+                pres_n = pres - nu * div + sp_q.to_ortho(pseu_n) / dt
+
+                # temperature (navier_eq.rs:209-224)
+                rhs = sp_t.to_ortho(temp)
+                rhs = rhs + tb_diff
+                rhs = rhs - dt * conv(ux, uy, sp_t, temp, with_bc=True)
+                with solve_scope():
+                    temp_n = sol_t.solve(pin(rhs))
+
+                if has_scal:
+                    # passive scalar (scenario modifier): the temperature's
+                    # advection-diffusion at the scalar diffusivity, same BC
+                    # lift — with matched diffusivity a scalar released
+                    # equal to the temperature stays identically equal
+                    # (exact validation case); the buoyancy never reads it
+                    # (one-way coupling, hence "passive")
+                    rhs = sp_t.to_ortho(state.scal)
+                    rhs = rhs + kc_over_ka * tb_diff  # dt*kc*lap(bc lift)
+                    rhs = rhs - dt * conv(ux, uy, sp_t, state.scal, with_bc=True)
+                    with solve_scope():
+                        scal_n = sol_c.solve(pin(rhs))
 
             if solid is not None:
                 # implicit pointwise Brinkman penalization (set_solid):
@@ -1047,6 +1105,7 @@ class Navier2D(CampaignModelBase, Integrate):
         "_tempbc_dx",
         "_tempbc_dy",
         "_tempbc_diff",
+        "_step_impl",
         "_solid",
     ) + CampaignModelBase._DT_ARTIFACTS
 
@@ -1068,6 +1127,9 @@ class Navier2D(CampaignModelBase, Integrate):
         xs, ys = (b.points for b in self.field_space.bases)
         with self._scope():
             self._build_bc_fields(xs, ys)
+        # the fused stage kernels bake dt into every term matrix (and the
+        # BC-lift constants above into the Helmholtz stages)
+        self._step_impl = self._build_step_kernels()
         if self._solid is not None:
             # rebuilds the dt/eta factors AND recompiles the entry points;
             # the obstacle itself is unchanged, so the per-rung cache stays

@@ -63,6 +63,45 @@ def ingredients_for_hholtz(space: Space2, axis: int):
     return mass, lap, None
 
 
+def hholtz_axis_solve_matrix(space: Space2, axis: int, ci: float) -> np.ndarray:
+    """Dense equivalent of ONE :class:`HholtzAdi` axis factor, in natural
+    (split-form for periodic) order: ``A = (mat_a - ci*mat_b)^-1 @ precond``
+    — the full 2-D ADI solve is ``A0 @ rhs @ A1^T``.  This is the public
+    modal contract the fused step kernels (ops/pallas_step.py) build their
+    stage matrices from: the banded recurrence, the precomputed dense
+    inverse, and this explicit inverse factor all solve the identical 1-D
+    system (machine-precision agreement in f64).
+
+    Periodic axes return the diagonal ``1/(1 + ci*k^2)`` in the split Re/Im
+    convention over ``2*(n//2+1)`` rows (each eigenvalue twice — complex r2c
+    bases get the duplication here, split bases already carry it), matching
+    ``Base.axis_operator``'s split-matrix form."""
+    base = space.bases[axis]
+    mat_a, mat_b, precond = ingredients_for_hholtz(space, axis)
+    mat = mat_a - ci * mat_b
+    if base.kind.is_periodic:
+        d = 1.0 / np.diag(mat)
+        if not base.kind.is_split:
+            d = np.concatenate([d, d])
+        return np.diag(d)
+    return np.linalg.solve(mat, precond)
+
+
+def modal_data_split(space: Space2, axis: int, ci: float, sign: float = 1.0):
+    """Public :func:`_axis_modal_data` in the split-real convention of the
+    fused step kernels: ``(lam, fwd, bwd)`` with periodic-axis eigenvalues
+    duplicated over the Re/Im blocks (complex r2c bases carry each
+    eigenvalue once; split bases already twice).  ``fwd``/``bwd`` are None
+    for periodic axes (already modal); eigenvalues come back in natural
+    order — sep-storage callers apply ``parity_perm`` themselves, exactly
+    like :class:`FastDiag`."""
+    lam, fwd, bwd = _axis_modal_data(space, axis, ci, sign)
+    base = space.bases[axis]
+    if base.kind.is_periodic and not base.kind.is_split:
+        lam = np.concatenate([lam, lam])
+    return lam, fwd, bwd
+
+
 def _checker_shift(m: np.ndarray) -> int | None:
     """Shift s in {0, 1} such that ``m[i, j] == 0`` (exactly) whenever
     ``(i + j + s)`` is odd; None if neither holds.  The pure-Chebyshev solver

@@ -120,6 +120,10 @@ def main() -> int:
         # interpreter mode) — merged into PARITY.json under "pallas_conv"
         # so kernel drift is tracked per-PR like the Nu trajectories
         "pallas_conv": _pallas_conv_parity(),
+        # fused-step (Helmholtz/Poisson solve megakernel) vs dense solver
+        # chain, 5-step trajectory parity per layout — merged into
+        # PARITY.json under "pallas_step" next to the conv kernel trend
+        "pallas_step": _pallas_step_parity(),
         # in-scan stats engine vs the eager legacy accumulator (max rel
         # diff per accumulated field) — merged into PARITY.json under
         # "stats" so accumulator drift is tracked per-PR too
@@ -389,6 +393,72 @@ def _pallas_conv_parity() -> dict | None:
     ``"pallas_conv"``."""
     return _parity_probe(
         _PALLAS_CONV_CHILD, "PALLAS_CONV_JSON ", "pallas_conv", "max_rel_diff"
+    )
+
+
+_PALLAS_STEP_CHILD = r"""
+import json, os, sys
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.setdefault("RUSTPDE_X64", "1")
+sys.path.insert(0, %(repo)r)
+import numpy as np
+import jax
+jax.config.update("jax_platforms", "cpu")
+import rustpde_mpi_tpu as rp
+
+def build(periodic, nx, ny, kernel):
+    os.environ["RUSTPDE_STEP_KERNEL"] = kernel
+    m = rp.Navier2D(nx, ny, 1e4, 1.0, 5e-3, 1.0, "rbc", periodic=periodic)
+    m.set_velocity(0.1, 1.0, 1.0)
+    m.set_temperature(0.1, 1.0, 1.0)
+    return m
+
+def delta(periodic, nx, ny, env=()):
+    for k, v in env:
+        os.environ[k] = v
+    try:
+        d = build(periodic, nx, ny, "dense")
+        p = build(periodic, nx, ny, "pallas")
+        assert p._step_impl is not None
+        d.update_n(5)
+        p.update_n(5)
+        # per-leaf deviations floored by the physical-field scale (the
+        # pseudo-pressure is ~zero at near-incompressibility: its own max
+        # is roundoff noise, not a meaningful denominator)
+        scale0 = max(
+            float(np.abs(np.asarray(x)).max())
+            for x in (d.state.temp, d.state.velx, d.state.vely)
+        )
+        rel = 0.0
+        for a, b in zip(p.state, d.state):
+            a, b = np.asarray(a), np.asarray(b)
+            den = max(float(np.abs(b).max()), scale0, 1e-30)
+            rel = max(rel, float(np.abs(a - b).max() / den))
+        return rel
+    finally:
+        for k, _ in env:
+            os.environ.pop(k, None)
+        os.environ.pop("RUSTPDE_STEP_KERNEL", None)
+
+deltas = {
+    "confined": delta(False, 17, 17),
+    "periodic_complex": delta(True, 16, 17),
+    "confined_sep": delta(False, 33, 33, (("RUSTPDE_FORCE_TPU_PATH", "1"),)),
+    "split_sep": delta(
+        True, 16, 17,
+        (("RUSTPDE_FORCE_TPU_PATH", "1"), ("RUSTPDE_SEP", "1")),
+    ),
+}
+print("PALLAS_STEP_JSON " + json.dumps(deltas))
+"""
+
+
+def _pallas_step_parity() -> dict | None:
+    """Max relative dense-vs-Pallas deviation of the fused solve/projection
+    step (5-step trajectory, ops/pallas_step.py) per layout, floored by the
+    physical-field scale — merged into PARITY.json under ``"pallas_step"``."""
+    return _parity_probe(
+        _PALLAS_STEP_CHILD, "PALLAS_STEP_JSON ", "pallas_step", "max_rel_diff"
     )
 
 
