@@ -73,6 +73,7 @@ DEFAULT_CONFIGS = [
     "pipeline129",
     "shardedio129",
     "serve129",
+    "autoscale129",
     "workloads129",
     "stats129",
     "pallasconv",
@@ -103,6 +104,7 @@ METRIC_NAMES = {
     "pipeline129": "2D RBC confined 129x129 Ra=1e7 overlapped I/O pipeline (async checkpoints + dispatch double-buffering)",
     "shardedio129": "2D RBC sharded two-phase checkpoints, 2-proc CPU harness (sharded vs gathered write + elastic-restore gate)",
     "serve129": "2D RBC simulation service 129x129 Ra=1e7, 200 requests / 8 slots soak (drain+NaN chaos; member-steps/s + latency percentiles)",
+    "autoscale129": "autoscaling fleet chaos soak 17x17 CPU (controller + launcher under Poisson notice-SIGTERM/SIGKILL preemptions; zero-lost + reclaimed-with-state + admission p99 gates)",
     "workloads129": "multi-model workloads 129x129 (dns/lnse/adjoint member-steps/s per kind + solo-vs-ensemble parity + lnse onset-sign gate)",
     "stats129": "2D RBC confined 129x129 Ra=1e7 in-scan physics stats (stats-on vs stats-off matched governed windows: bit-equal trajectory + <=5% overhead + budget-closure gates)",
     "pallasconv": "fused Pallas convection + solve megakernels vs unfused dense (RUSTPDE_CONV_KERNEL / RUSTPDE_STEP_KERNEL A/B: ms/step + MFU + bit-tolerance + HBM-traffic deltas; 129x129 min, flagship rows on-chip)",
@@ -1112,6 +1114,149 @@ def _serve_fleet_leg(run_dir, timeout_s=900):
                 p.kill()
         for log in logs.values():
             log.close()
+
+
+def bench_autoscale(timeout_s=1200):
+    """autoscale129: the autoscaling-fleet chaos leg (ISSUE 17).
+
+    One standalone controller process (examples/navier_rbc_autoscale.py)
+    scales a LocalProcessLauncher replica fleet for a seeded backlog on
+    the small 17^2 tier shape while a Poisson schedule preempts its own
+    replicas — a notice-SIGTERM + hard-SIGKILL mix, each arrival held
+    until its victim provably holds mid-flight parked state so every
+    preemption exercises the reclaim-WITH-state path.  Like the serve129
+    fleet leg this measures fleet mechanics, not step throughput.
+
+    Gates: zero_lost (every request done, zero failed, nothing stranded
+    queued/running), reclaimed_with_state (some replica journaled
+    continuation_resumed with steps > 0), preempted (the chaos actually
+    fired), and slo_ok (p99 admission-to-first-observable under a CPU-
+    tier bound that absorbs replica cold starts: each spawn pays a full
+    interpreter + JAX import + first compile before its first chunk).
+    Decision/spawn/retire counts come from the controller journal."""
+    import shutil
+    import subprocess
+    import tempfile
+
+    import numpy as np
+
+    from rustpde_mpi_tpu.serve import DurableQueue
+    from rustpde_mpi_tpu.utils.journal import read_journal
+
+    n_req = int(os.environ.get("RUSTPDE_AUTOSCALE_BENCH_REQUESTS", "6"))
+    run_dir = tempfile.mkdtemp(prefix="bench_autoscale_")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("RUSTPDE_FAULT", None)
+    t_start = time.perf_counter()
+    try:
+        proc = subprocess.run(
+            [
+                sys.executable,
+                os.path.join(_REPO, "examples", "navier_rbc_autoscale.py"),
+                "--run-dir", run_dir, "--requests", str(n_req),
+                "--seed", "7", "--horizon", "1.5",
+                "--min-replicas", "1", "--max-replicas", "2",
+                "--queue-high", "1", "--sustain-s", "1",
+                "--cooldown-s", "2", "--decide-s", "0.5",
+                "--notice-s", "8", "--lease-ttl-s", "3",
+                "--heartbeat-s", "0.2", "--chunk-steps", "8",
+                "--chaos-preempts", "2", "--chaos-kill-frac", "0.5",
+                "--chaos-mean-gap-s", "1",
+            ],
+            capture_output=True, text=True, timeout=timeout_s, env=env,
+            cwd=_REPO,
+        )
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"autoscale driver rc={proc.returncode}: "
+                f"{proc.stderr[-1500:]}"
+            )
+        final = [
+            json.loads(line)
+            for line in proc.stdout.splitlines()
+            if line.startswith("{")
+        ][-1]
+        wall = time.perf_counter() - t_start
+
+        counts = DurableQueue(
+            os.path.join(run_dir, "queue"), max_queue=4 * n_req
+        ).counts()
+        latencies, completed_steps = [], 0
+        done_dir = os.path.join(run_dir, "queue", "done")
+        for name in sorted(os.listdir(done_dir)):
+            with open(os.path.join(done_dir, name)) as fh:
+                res = json.load(fh)["result"]
+            latencies.append(res["admission_to_first_observable_s"])
+            completed_steps += res["steps"]
+        pct = lambda vals, p: float(
+            np.sort(np.asarray(vals))[
+                min(len(vals) - 1, int(p / 100 * len(vals)))
+            ]
+        ) if vals else None
+
+        # journals: autoscale_* rows from the controller dir, lifecycle
+        # evidence (notice drains, resumed continuations) from every
+        # replica dir — autoscaled replica ids are not known a priori
+        tallies = {
+            "autoscale_decision": 0, "replica_spawned": 0,
+            "replica_retired": 0, "preempt_notice": 0,
+            "continuation_persisted": 0, "lease_broken": 0,
+        }
+        resumed = 0
+        rroot = os.path.join(run_dir, "replicas")
+        for name in sorted(os.listdir(rroot)):
+            jpath = os.path.join(rroot, name, "journal.jsonl")
+            if not os.path.isfile(jpath):
+                continue
+            for e in read_journal(jpath, on_error="skip"):
+                ev = e.get("event")
+                if ev in tallies:
+                    tallies[ev] += 1
+                if ev == "continuation_resumed" and e.get("steps", 0) > 0:
+                    resumed += 1
+
+        # CPU-tier SLO bound: cold replica start (interpreter + JAX import
+        # + first compile) dominates; the gate catches requests STARVED by
+        # a broken control loop, not steady-state latency
+        slo_bound_s = 600.0
+        p99 = pct(latencies, 99)
+        preempts = final.get("notice", 0) + final.get("kill", 0)
+        zero_lost = counts == {
+            "queued": 0, "running": 0, "done": n_req, "failed": 0
+        }
+        return {
+            # headline rate: fleet-mechanics leg — completed member-steps
+            # over the whole scaled-and-preempted soak wall
+            "steps_per_sec": completed_steps / max(wall, 1e-9),
+            "unit_note": (
+                "steps_per_sec = member-steps/s across the autoscaled "
+                "chaos soak (17^2 CPU fleet; mechanics, not throughput)"
+            ),
+            "requests": n_req,
+            "counts": counts,
+            "decisions": final.get("decisions", 0),
+            "spawned": final.get("spawned", 0),
+            "retired": final.get("retired", 0),
+            "preempts_notice": final.get("notice", 0),
+            "preempts_kill": final.get("kill", 0),
+            "preempts_dropped": final.get("dropped", 0),
+            "journal": tallies,
+            "resumed_mid_flight": resumed,
+            "admission_p50_s": pct(latencies, 50),
+            "admission_p99_s": p99,
+            "slo_bound_s": slo_bound_s,
+            "wall_s": round(wall, 1),
+            "zero_lost": zero_lost,
+            "reclaimed_with_state": resumed > 0,
+            "preempted": preempts >= 1,
+            "slo_ok": p99 is not None and p99 < slo_bound_s,
+            "finite": bool(
+                zero_lost and resumed > 0 and preempts >= 1
+                and p99 is not None and p99 < slo_bound_s
+            ),
+        }
+    finally:
+        shutil.rmtree(run_dir, ignore_errors=True)
 
 
 def bench_serve(nx=129, ny=129, ra=1e7, dt=2e-3, steps_per_req=8):
@@ -2139,6 +2284,10 @@ def main() -> int:
                 # simulation-service soak: 200 requests through 8 slots in
                 # subprocess incarnations (drain + NaN chaos cycle)
                 r = bench_serve()
+            elif name == "autoscale129":
+                # autoscaled fleet under Poisson preemptions (ISSUE 17):
+                # controller + launcher chaos leg, fleet mechanics gates
+                r = bench_autoscale()
             elif name == "workloads129":
                 # multi-model campaign rates (dns/lnse/adjoint) + the
                 # parity and onset-sign gates

@@ -1,0 +1,210 @@
+"""Autoscaling fleet on preemptible capacity: controller + chaos driver.
+
+One process runs the :class:`Autoscaler` control loop over a shared
+``--run-dir``: it watches the durable queue (depth, deadline slack) and
+the replica heartbeats, and drives a :class:`LocalProcessLauncher` that
+spawns/retires ``SimServer`` replicas as subprocesses.  Optionally it
+plays the preemptible-capacity adversary against its own fleet — a
+Poisson arrival process of preemptions, each either a notice-SIGTERM
+(the replica parks its running slots durably inside the
+``RUSTPDE_PREEMPT_NOTICE_S`` window and releases its leases) or a hard
+SIGKILL (survivors break the dead replica's leases and resume from the
+parked continuations).  Loss-free either way.
+
+Seed some work and let the controller scale for it::
+
+    python examples/navier_rbc_autoscale.py --run-dir data/autoscale \
+        --requests 6 --max-replicas 3 --notice-s 5
+
+Chaos soak — preempt twice, half of them hard kills::
+
+    python examples/navier_rbc_autoscale.py --run-dir data/autoscale \
+        --requests 6 --chaos-preempts 2 --chaos-kill-frac 0.5 --seed 7
+
+``--steps N`` bounds the controller to N decide ticks (0 = run until the
+queue drains and the fleet is idle); the exit line is a JSON summary of
+decisions/spawns/retirements/preemptions for drivers to parse.
+"""
+
+import argparse
+import json
+import os
+import random
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from rustpde_mpi_tpu.config import AutoscaleConfig  # noqa: E402
+
+
+def persisted_mid_flight(run_dir: str, rid: str) -> bool:
+    """Has this replica durably parked a mid-flight continuation yet?
+    The chaos schedule only preempts victims that will resume WITH state
+    — an idle or still-importing replica proves nothing."""
+    path = os.path.join(run_dir, "replicas", rid, "journal.jsonl")
+    try:
+        with open(path, encoding="utf-8") as fh:
+            for line in fh:
+                try:
+                    event = json.loads(line)
+                except ValueError:
+                    continue
+                if (event.get("event") == "continuation_persisted"
+                        and event.get("steps", 0) > 0):
+                    return True
+    except OSError:
+        pass
+    return False
+
+
+def submit_requests(run_dir: str, n: int, seed: int,
+                    horizon: float) -> list[str]:
+    """Durably enqueue n small RBC requests (the controller scales FOR
+    work, so the demo seeds some) — same fsynced handoff a proxy makes."""
+    from rustpde_mpi_tpu.serve.queue import DurableQueue
+    from rustpde_mpi_tpu.serve.request import SimRequest
+
+    rng = random.Random(seed)
+    queue = DurableQueue(os.path.join(run_dir, "queue"), max_queue=1 << 20)
+    ids = []
+    for i in range(n):
+        req = SimRequest.from_dict(
+            {
+                "ra": rng.choice([1e4, 2e4]),
+                "nx": 17,
+                "ny": 17,
+                "dt": 0.01,
+                "horizon": horizon,
+                "tenant": f"t{i % 2}",
+            }
+        )
+        req.validate()
+        queue.submit(req)
+        ids.append(req.id)
+    return ids
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--run-dir", default="data/autoscale")
+    ap.add_argument("--requests", type=int, default=0,
+                    help="seed this many small RBC requests before scaling")
+    ap.add_argument("--horizon", type=float, default=0.5,
+                    help="sim horizon of each seeded request")
+    ap.add_argument("--min-replicas", type=int, default=1)
+    ap.add_argument("--max-replicas", type=int, default=3)
+    ap.add_argument("--queue-high", type=int, default=4)
+    ap.add_argument("--sustain-s", type=float, default=2.0)
+    ap.add_argument("--idle-sustain-s", type=float, default=6.0)
+    ap.add_argument("--slack-low-s", type=float, default=30.0)
+    ap.add_argument("--cooldown-s", type=float, default=10.0)
+    ap.add_argument("--decide-s", type=float, default=1.0)
+    ap.add_argument("--notice-s", type=float, default=None,
+                    help="arm RUSTPDE_PREEMPT_NOTICE_S in spawned replicas")
+    ap.add_argument("--steps", type=int, default=0,
+                    help="controller decide ticks (0 = until drained + idle)")
+    ap.add_argument("--chaos-preempts", type=int, default=0,
+                    help="total Poisson-arrival preemptions to inject")
+    ap.add_argument("--chaos-kill-frac", type=float, default=0.5,
+                    help="fraction of preemptions that SIGKILL (vs notice)")
+    ap.add_argument("--chaos-mean-gap-s", type=float, default=4.0)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--slots", type=int, default=2)
+    ap.add_argument("--chunk-steps", type=int, default=4)
+    ap.add_argument("--lease-ttl-s", type=float, default=None)
+    ap.add_argument("--heartbeat-s", type=float, default=None)
+    args = ap.parse_args()
+
+    import time
+
+    from rustpde_mpi_tpu.serve.fleet import Autoscaler, LocalProcessLauncher
+
+    os.makedirs(args.run_dir, exist_ok=True)
+    if args.requests:
+        ids = submit_requests(args.run_dir, args.requests, args.seed,
+                              args.horizon)
+        print(json.dumps({"submitted": ids}), flush=True)
+
+    serve_args = ["--slots", str(args.slots),
+                  "--chunk-steps", str(args.chunk_steps)]
+    if args.lease_ttl_s is not None:
+        serve_args += ["--lease-ttl-s", str(args.lease_ttl_s)]
+    if args.heartbeat_s is not None:
+        serve_args += ["--heartbeat-s", str(args.heartbeat_s)]
+    launcher = LocalProcessLauncher(
+        args.run_dir, serve_args=serve_args, notice_s=args.notice_s,
+        log_dir=os.path.join(args.run_dir, "launcher-logs"),
+    )
+    asc = Autoscaler(
+        args.run_dir,
+        launcher,
+        AutoscaleConfig(
+            min_replicas=args.min_replicas,
+            max_replicas=args.max_replicas,
+            queue_high=args.queue_high,
+            sustain_s=args.sustain_s,
+            idle_sustain_s=args.idle_sustain_s,
+            slack_low_s=args.slack_low_s,
+            cooldown_s=args.cooldown_s,
+            decide_s=args.decide_s,
+            notice_s=args.notice_s,
+        ),
+    )
+
+    # Poisson-arrival preemption schedule: exponential gaps, deterministic
+    # under --seed, each one SIGKILL with prob --chaos-kill-frac else a
+    # notice-SIGTERM retire through the launcher
+    rng = random.Random(args.seed + 1)
+    chaos_at = []
+    t = time.monotonic()
+    for _ in range(args.chaos_preempts):
+        t += rng.expovariate(1.0 / max(0.1, args.chaos_mean_gap_s))
+        chaos_at.append(t)
+    preempted = {"notice": 0, "kill": 0, "dropped": 0}
+
+    tick = 0
+    try:
+        while True:
+            decision = asc.step()
+            tick += 1
+            now = time.monotonic()
+            while chaos_at and chaos_at[0] <= now:
+                victims = [h for h in launcher.handles()
+                           if launcher.alive(h) and not h.retired
+                           and persisted_mid_flight(args.run_dir,
+                                                    h.replica_id)]
+                if not victims:
+                    # a due arrival is HELD until some replica is provably
+                    # mid-flight (has parked state to resume from) — but
+                    # a drained queue will never produce one: drop then
+                    if decision["queued"] == 0 and decision["running"] == 0:
+                        preempted["dropped"] += len(chaos_at)
+                        chaos_at.clear()
+                    break
+                chaos_at.pop(0)
+                victim = rng.choice(victims)
+                if rng.random() < args.chaos_kill_frac:
+                    launcher.kill(victim)
+                    preempted["kill"] += 1
+                else:
+                    launcher.retire(victim)  # SIGTERM -> notice drain
+                    preempted["notice"] += 1
+                print(json.dumps({"chaos_preempt": victim.replica_id,
+                                  **preempted}), flush=True)
+            if args.steps and tick >= args.steps:
+                break
+            if not args.steps and not chaos_at:
+                # every submitted request reached a terminal state: done
+                # (the finally clause retires whatever fleet remains)
+                if decision["queued"] == 0 and decision["running"] == 0:
+                    break
+            time.sleep(args.decide_s)
+    finally:
+        asc.stop(retire_fleet=True)
+    print(json.dumps({"outcome": "done", **asc.stats(), **preempted}),
+          flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -163,6 +163,13 @@ register_knob("RUSTPDE_FLEET_HEARTBEAT_S", None,
 register_knob("RUSTPDE_FLEET_QUOTA", None,
               "default per-tenant admission quota (queued+running; "
               "unset = unlimited)")
+register_knob("RUSTPDE_PREEMPT_NOTICE_S", None,
+              "preemption-notice window: SIGTERM on a fleet replica parks "
+              "every running slot durably + releases leases within this "
+              "many seconds (unset = full graceful drain)")
+register_knob("RUSTPDE_PROXY_TOKENS", None,
+              "comma-separated bearer-token allowlist for proxy mutating "
+              "endpoints (unset = open admission)")
 # collective-sequence sanitizer (parallel/sanitizer.py)
 register_knob("RUSTPDE_SANITIZE", "0",
               "1 = record every multihost collective + cadenced cross-host "
@@ -191,6 +198,9 @@ register_knob("RUSTPDE_SERVE_MP_REQUESTS", "4",
               "serve129 2-proc leg request count", "bench")
 register_knob("RUSTPDE_FLEET_BENCH_REQUESTS", "10",
               "serve129 fleet leg request count (proxy + 2 replicas)", "bench")
+register_knob("RUSTPDE_AUTOSCALE_BENCH_REQUESTS", "6",
+              "autoscale129 chaos leg request count (autoscaled fleet under "
+              "Poisson preemptions)", "bench")
 # test harness (tests/ — raw reads allowed, names registered)
 register_knob("RUSTPDE_SLOW", None, "1 = run the slow test tier", "test")
 register_knob("RUSTPDE_TEST_BUDGET_S", "45", "per-test wall budget (fast tier)", "test")
@@ -529,6 +539,52 @@ class FleetConfig:
 
 
 @dataclass
+class AutoscaleConfig:
+    """Control law for the fleet autoscaler (serve/fleet/autoscaler.py): a
+    controller that reads the signals the fleet already exports (queue
+    depth + per-tenant census, deadline slack from the QoS ordering,
+    replica heartbeats) and drives a pluggable ``ReplicaLauncher``.
+
+    Scale-OUT (one replica per decision, bounded by ``max_replicas``):
+
+    * deadline pressure — a queued deadline-bearing request's slack fell
+      below ``slack_low_s`` (immediate: waiting out a sustain window is
+      exactly how the deadline is missed),
+    * queue pressure — queued depth above ``queue_high`` continuously for
+      ``sustain_s``,
+    * capacity repair — live replicas below ``min_replicas`` (immediate
+      and exempt from the cooldown: replacing preempted capacity must not
+      wait out the window that throttles elective growth).
+
+    Scale-IN (one replica per decision, bounded by ``min_replicas``): the
+    fleet fully idle — nothing queued, nothing running — continuously for
+    ``idle_sustain_s``.  The victim is retired by SIGTERM through the
+    existing park machinery (running slots persist as durable
+    continuations, leases release, exit clean), never killed.
+
+    Hysteresis = the separate sustain windows; ``cooldown_s`` additionally
+    spaces consecutive elective actions.  A spawned replica counts toward
+    the fleet for ``spawn_grace_s`` before its first heartbeat lands, so a
+    slow JAX import cannot read as missing capacity and storm spawns.
+
+    ``notice_s`` seeds ``RUSTPDE_PREEMPT_NOTICE_S`` in launched replicas
+    (None: inherit the environment): preemptible capacity should drain
+    urgently when its platform says the clock is running."""
+
+    min_replicas: int = 1
+    max_replicas: int = 4
+    queue_high: int = 8
+    sustain_s: float = 5.0
+    idle_sustain_s: float = 15.0
+    slack_low_s: float = 30.0
+    cooldown_s: float = 30.0
+    decide_s: float = 2.0
+    spawn_grace_s: float = 60.0
+    notice_s: float | None = None
+    replica_prefix: str = "auto"
+
+
+@dataclass
 class ServeConfig:
     """Knobs for the fault-isolated simulation service
     (:class:`~rustpde_mpi_tpu.serve.SimServer`): a persistent driver that
@@ -617,6 +673,14 @@ class ServeConfig:
     # enforces the QoS traffic contract (quotas, priority classes,
     # deadlines, preemption).  Pair with serve/fleet/proxy.py fronts.
     fleet: FleetConfig | None = None
+    # embedded fleet autoscaler (None = off, the default: byte-identical
+    # serve behavior — zero extra journal rows, zero extra collectives, no
+    # controller threads, CI-asserted).  Set (fleet mode, root only) it
+    # starts an Autoscaler daemon thread next to the heartbeat thread:
+    # pure host-side file IO + subprocess spawns through a local
+    # ReplicaLauncher — never a collective.  The controller can equally
+    # run standalone (examples/navier_rbc_autoscale.py).
+    autoscale: AutoscaleConfig | None = None
 
 
 @dataclass

@@ -23,6 +23,12 @@ Enable per replica via ``ServeConfig(fleet=FleetConfig(...))``; with
 journal rows, zero extra collectives.
 """
 
+from .autoscaler import Autoscaler  # noqa: F401
+from .launcher import (  # noqa: F401
+    LocalProcessLauncher,
+    ReplicaHandle,
+    ReplicaLauncher,
+)
 from .lease import Lease, LeaseLost, LeaseManager, bucket_tag  # noqa: F401
 from .proxy import (  # noqa: F401
     FleetProxy,

@@ -9,9 +9,19 @@ TCP load-balancer and any single process death loses nothing::
 
     POST /requests        validate + QoS quota check + fsynced enqueue
                           -> 202 {"id","steps","trace_id"}; 429 + a
-                          Retry-After header + queue depth on rejection
-                          (queue_full / quota), 400 malformed, 413 big
+                          jittered queue-depth-derived Retry-After header
+                          on rejection (queue_full / quota), 400
+                          malformed, 413 big; when a bearer-token
+                          allowlist is configured (``RUSTPDE_PROXY_TOKENS``
+                          or ``auth_tokens=``), 401 ``auth_missing`` /
+                          403 ``auth_invalid`` with constant-time compares
     GET  /requests/<id>   lifecycle record from durable state (404)
+    GET  /requests/<id>/trace
+                          cross-replica Perfetto timeline: proxy
+                          admission + every replica's lifecycle rows +
+                          campaign chunk spans stitched from the
+                          ``replicas/<rid>/`` journals (one process lane
+                          per journal source)
     GET  /stats           queue counts + per-tenant census + bucket
                           leases + replica heartbeat aggregation
     GET  /healthz         {"ok", "proxy", "queue", "replicas"} — a
@@ -34,14 +44,18 @@ verdict, and the proxy serves the aggregate on /stats and /healthz.
 
 from __future__ import annotations
 
+import hmac
 import json
 import os
 import threading
 import time
+import warnings
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
+from ...config import env_get
 from ...telemetry import metrics as _tm
 from ...telemetry.exporters import PROMETHEUS_CONTENT_TYPE, prometheus_text
+from ...telemetry.reqtrace import assemble_fleet_request_trace
 from ...utils.fsutil import atomic_write_text
 from ...utils.journal import JournalWriter
 from ..http_front import read_body, rejection_payload, reply_json, reply_text
@@ -78,7 +92,14 @@ def read_replica_status(run_dir: str, ttl_s: float) -> list[dict]:
     """Every replica's last heartbeat, staleness-marked: ``stale`` is true
     when the heartbeat file has not been rewritten for ``ttl_s`` (file
     mtime vs this process's clock — display-grade; the authoritative
-    failure detector is the lease sweep's observer-monotonic window)."""
+    failure detector is the lease sweep's observer-monotonic window).
+
+    A heartbeat file that exists but won't parse (torn/truncated JSON —
+    a crashed writer, a reader racing a non-atomic copy tool) is NOT a
+    missing replica: it surfaces as a ``stale`` + ``torn`` entry with a
+    warning, so autoscalers and dashboards see a sick replica instead of
+    silently forgetting one.  Files that vanish mid-scan (replica
+    retirement unlinking its heartbeat) are still skipped."""
     root = replicas_dir(run_dir)
     out = []
     try:
@@ -92,9 +113,27 @@ def read_replica_status(run_dir: str, ttl_s: float) -> list[dict]:
         path = os.path.join(root, name)
         try:
             age = now - os.stat(path).st_mtime
+        except OSError:
+            continue  # unlinked between listdir and stat
+        try:
             with open(path, encoding="utf-8") as fh:
                 rec = json.load(fh)
-        except (OSError, ValueError):
+        except OSError:
+            continue
+        except ValueError:
+            warnings.warn(
+                f"torn replica heartbeat {path}: treating as stale",
+                RuntimeWarning,
+                stacklevel=2,
+            )
+            out.append(
+                {
+                    "replica": name[: -len(".json")],
+                    "torn": True,
+                    "hb_age_s": round(age, 3),
+                    "stale": True,
+                }
+            )
             continue
         rec["hb_age_s"] = round(age, 3)
         rec["stale"] = age > float(ttl_s)
@@ -110,7 +149,15 @@ class FleetProxy:
     serves without quotas (pure pass-through admission).  ``start()``
     binds (port 0 = ephemeral, see ``address``), ``stop()`` shuts down.
     Thread-safe by construction: handlers touch only the (locked) queue
-    object and read-only durable state."""
+    object and read-only durable state.
+
+    ``auth_tokens`` is the bearer-token allowlist for MUTATING endpoints
+    (POST /requests): any presented token must match one entry under a
+    constant-time compare.  ``None`` defaults from the comma-separated
+    ``RUSTPDE_PROXY_TOKENS`` knob; an empty list serves open (the
+    pre-auth behavior, and the right call behind a trusted LB).  Reads
+    (/stats, /healthz, /metrics, GET /requests/*) stay open: they expose
+    no tenant payloads and orchestrator probes must not need secrets."""
 
     def __init__(
         self,
@@ -120,9 +167,14 @@ class FleetProxy:
         max_queue: int = 256,
         fleet=None,
         registry=None,
+        auth_tokens: list[str] | None = None,
     ):
         self.run_dir = run_dir
         self.fleet = fleet
+        if auth_tokens is None:
+            raw = env_get("RUSTPDE_PROXY_TOKENS") or ""
+            auth_tokens = [t.strip() for t in raw.split(",") if t.strip()]
+        self.auth_tokens = tuple(auth_tokens)
         self.queue = DurableQueue(
             os.path.join(run_dir, "queue"), max_queue=int(max_queue)
         )
@@ -169,6 +221,39 @@ class FleetProxy:
         self._journal_writer.append({"proxy": self.proxy_id, **event})
 
     # -- the admission path (shared by every proxy endpoint handler) ----------
+
+    def auth_check(self, headers) -> tuple[int, dict, dict] | None:
+        """Bearer-token gate for mutating endpoints: ``None`` admits,
+        else ``(status, payload, extra_headers)`` — 401 ``auth_missing``
+        (no/malformed Authorization header, with a ``WWW-Authenticate``
+        challenge) or 403 ``auth_invalid`` (well-formed but unknown
+        token).  Every configured token is compared via
+        :func:`hmac.compare_digest`, and ALL of them are always checked,
+        so response timing leaks neither prefix matches nor which slot
+        matched.  No tokens configured = open admission."""
+        if not self.auth_tokens:
+            return None
+        presented = ""
+        header = headers.get("Authorization") or ""
+        if header.startswith("Bearer "):
+            presented = header[len("Bearer ") :].strip()
+        if not presented:
+            code, reason = 401, "auth_missing"
+            extra = {"WWW-Authenticate": "Bearer"}
+        else:
+            ok = False
+            for token in self.auth_tokens:
+                ok |= hmac.compare_digest(presented, token)
+            if ok:
+                return None
+            code, reason, extra = 403, "auth_invalid", {}
+        self.registry.counter(
+            "fleet_auth_rejected_total",
+            "mutating requests rejected by the proxy bearer-token gate",
+            reason=reason,
+        ).inc()
+        self._journal({"event": "auth_rejected", "reason": reason})
+        return code, {"error": "unauthorized", "reason": reason}, extra
 
     def submit(self, data: dict) -> SimRequest:
         """Validate + QoS-admit + durably enqueue one request.  The proxy
@@ -270,6 +355,16 @@ class FleetProxy:
                     )
                 if self.path == "/stats":
                     return reply_json(self, 200, proxy.stats())
+                if self.path.startswith("/requests/") and self.path.endswith(
+                    "/trace"
+                ):
+                    rid = self.path.strip("/").split("/")[-2]
+                    payload = assemble_fleet_request_trace(proxy.run_dir, rid)
+                    if payload is None:
+                        return reply_json(
+                            self, 404, {"error": "unknown request id"}
+                        )
+                    return reply_json(self, 200, payload)
                 if self.path.startswith("/requests/"):
                     rid = self.path.strip("/").split("/")[-1]
                     proxy.queue.invalidate()  # replicas mutate behind us
@@ -292,6 +387,10 @@ class FleetProxy:
                 ).inc()
                 if self.path != "/requests":
                     return reply_json(self, 404, {"error": "unknown endpoint"})
+                denied = proxy.auth_check(self.headers)
+                if denied is not None:
+                    code, payload, extra = denied
+                    return reply_json(self, code, payload, extra)
                 body, err = read_body(self)
                 if err is not None:
                     code, message = err

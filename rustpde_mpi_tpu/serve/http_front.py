@@ -38,6 +38,7 @@ The front is deliberately minimal — no auth, no TLS, bind it to loopback
 from __future__ import annotations
 
 import json
+import random
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
@@ -100,12 +101,33 @@ def read_body(handler):
     return body, None
 
 
+#: jitter stream for 429 Retry-After values — module-level so tests can
+#: pin it (seed_retry_jitter) and every front in the process shares one
+#: sequence; NOT the random module's global state, which user code owns
+_retry_jitter = random.Random()
+
+
+def seed_retry_jitter(seed) -> None:
+    """Re-seed the Retry-After jitter stream.  Tests pin this for
+    deterministic backoff assertions; production leaves it entropy-seeded
+    so a rejected client herd doesn't re-arrive in lockstep."""
+    _retry_jitter.seed(seed)
+
+
 def rejection_payload(exc: AdmissionError, queue_depth: int):
     """The 429 body + headers for one admission rejection: machine-
     readable reason, the live queue depth, and a ``Retry-After`` both in
     the JSON and as the standard header — so clients can back off
-    intelligently instead of hammering a full queue."""
-    retry_after = max(1, int(round(exc.retry_after_s)))
+    intelligently instead of hammering a full queue.
+
+    The advice is queue-depth-derived and JITTERED: the base grows with
+    the live backlog (a deep queue needs longer than the exception's
+    floor to drain) and a ±50% multiplicative jitter de-synchronizes the
+    herd — N clients rejected in the same burst must not all come back
+    on the same second.  Invariants the clients rely on: the value is an
+    integer ≥ 1 and the header always equals the JSON field."""
+    base_s = float(exc.retry_after_s) + 0.25 * max(0, int(queue_depth))
+    retry_after = max(1, int(round(base_s * _retry_jitter.uniform(0.5, 1.5))))
     payload = {
         "error": str(exc),
         "reason": exc.reason,

@@ -224,6 +224,18 @@ class SimServer:
             fault if fault is not None else env_get("RUSTPDE_FAULT")
         )
         self._drain = False
+        # preemption notice (RUSTPDE_PREEMPT_NOTICE_S, fleet mode): a
+        # SIGTERM arms a monotonic deadline; the drain path then parks
+        # running slots as durable continuations instead of the full
+        # campaign checkpoint — sized to finish inside the window, with
+        # the already-loss-free SIGKILL path as the clock-ran-out
+        # fallback.  The handler only sets the deadline: journaling is
+        # deferred to the next safe point (_log_preempt_notice).
+        self._notice_s = float(env_get("RUSTPDE_PREEMPT_NOTICE_S") or 0.0)
+        self._notice_deadline: float | None = None
+        self._notice_logged = False
+        # embedded fleet autoscaler (cfg.autoscale; None = nothing runs)
+        self._autoscaler = None
         self._runner: ResilientRunner | None = None
         # bucket fairness: the key served by the previous campaign (the
         # round-robin cursor) + this campaign's claim budget consumption
@@ -494,6 +506,8 @@ class SimServer:
                 "quota_rejected": self._quota_rejected,
                 "continuations_persisted": self._continuations,
             }
+            if self._autoscaler is not None:
+                out["fleet"]["autoscale"] = self._autoscaler.stats()
         return out
 
     # -- service loop ---------------------------------------------------------
@@ -533,6 +547,7 @@ class SimServer:
         )
         self._fleet_heartbeat(force=True)
         self._start_heartbeat_thread()
+        self._start_autoscaler()
         self._sync("serve-start")
         try:
             while not self._drain_agreed():
@@ -544,6 +559,7 @@ class SimServer:
                     continue
                 self._run_campaign(key)
             if self._drain:
+                self._log_preempt_notice()
                 self._journal({"event": "drain"})
         finally:
             import sys as _sys
@@ -586,6 +602,7 @@ class SimServer:
                 MetricsDumper(
                     os.path.join(self._replica_dir, "metrics.jsonl")
                 ).dump(step=self._global_step)
+            self._stop_autoscaler()
             self._stop_heartbeat_thread()
             self._fleet_heartbeat(force=True, stopping=True)
             self._journal_writer.close()  # reopens lazily if used again
@@ -661,6 +678,15 @@ class SimServer:
 
     def _install_signals(self) -> None:
         def handler(signum, frame):
+            # flag-sets only: journaling from a signal handler could
+            # deadlock on a writer lock the interrupted frame holds
+            if (
+                signum == signal.SIGTERM
+                and self._notice_s > 0
+                and self._fleet is not None
+                and self._notice_deadline is None
+            ):
+                self._notice_deadline = time.monotonic() + self._notice_s
             self.request_drain()
 
         try:
@@ -849,6 +875,58 @@ class SimServer:
                 self._hb_thread.join(timeout=5.0)
             self._hb_thread = None
             self._hb_stop = None
+
+    def _start_autoscaler(self) -> None:
+        """Embedded fleet controller (``cfg.autoscale``; root + fleet
+        only): an Autoscaler daemon thread driving a local-subprocess
+        launcher — pure host-side file IO + process control, never a
+        collective.  With ``autoscale=None`` (the default) NOTHING here
+        runs: serve behavior stays byte-identical (CI-asserted)."""
+        if (
+            self.cfg.autoscale is None
+            or self._fleet is None
+            or not self._is_root()
+        ):
+            return
+        from .fleet.autoscaler import Autoscaler
+        from .fleet.launcher import LocalProcessLauncher
+
+        self._autoscaler = Autoscaler(
+            self.cfg.run_dir,
+            LocalProcessLauncher(
+                self.cfg.run_dir, notice_s=self.cfg.autoscale.notice_s
+            ),
+            self.cfg.autoscale,
+            fleet=self._fleet,
+            controller_id=f"autoscaler-{self._replica_id}",
+        )
+        self._autoscaler.start()
+
+    def _stop_autoscaler(self) -> None:
+        if self._autoscaler is not None:
+            # the embedded controller dies with its host replica: retire
+            # the replicas it launched (graceful drain — their running
+            # slots park durably and their leases release) so a serve()
+            # exit never orphans subprocesses
+            self._autoscaler.stop(retire_fleet=True)
+            self._autoscaler = None
+
+    def _log_preempt_notice(self) -> None:
+        """Journal the armed preemption notice ONCE, at the first safe
+        point after the signal (never from the handler itself — the
+        interrupted frame may hold the journal writer's lock)."""
+        if self._notice_deadline is None or self._notice_logged:
+            return
+        self._notice_logged = True
+        self._journal(
+            {
+                "event": "preempt_notice",
+                "notice_s": self._notice_s,
+                "remaining_s": round(
+                    self._notice_deadline - time.monotonic(), 3
+                ),
+            }
+        )
 
     def _campaign_dir(self, key: tuple) -> str:
         tag = hashlib.sha1(repr(key).encode()).hexdigest()[:12]
@@ -2204,14 +2282,47 @@ class SimServer:
         (collective — every host is here together, the drain verdict was
         root-broadcast), then re-enqueue every unfinished request on root
         (progress stamped for the record; the checkpoint is what actually
-        restores it)."""
+        restores it).
+
+        Under an ARMED preemption notice (``RUSTPDE_PREEMPT_NOTICE_S``,
+        fleet mode) the drain turns urgent — park everything, release
+        leases, exit: running slots persist as durable per-request
+        continuations (O(slots) small two-phase writes, the exact state
+        a lease-breaking survivor resumes from) instead of the sharded
+        campaign checkpoint the notice window may not afford, and the
+        trace/incident flushes are skipped when the remaining clock is
+        short.  Both verdicts ride one root plan so every host takes the
+        same branch; if the window still runs out, the SIGKILL that
+        follows is the already-loss-free path."""
+
+        def _plan():
+            if self._notice_deadline is None:
+                return [0, 1]
+            remaining = self._notice_deadline - time.monotonic()
+            return [1, 1 if remaining > 1.0 else 0]
+
+        urgent, full_io = (
+            (bool(v) for v in self._root_plan(_plan))
+            if self._fleet is not None
+            else (False, True)
+        )
+        self._log_preempt_notice()
         self._flush_results(force=True)
         _tr.instant("drain", step=runner.step)
         running = [s for s in slots if s.running]
-        path = None
-        if running:
-            path = runner.checkpoint_now("drain")
         done = np.asarray(ens.steps_done)
+        path = None
+        if running and not urgent:
+            path = runner.checkpoint_now("drain")
+        if running and urgent:
+            for s in running:
+                state = ens.member_state(s.index)
+                self._write_continuation(
+                    s.req,
+                    state,
+                    s.base + int(done[s.index]),
+                    s.time_base + int(done[s.index]) * float(s.req.dt),
+                )
         for s in running:
             req = dataclasses.replace(
                 s.req, progress=s.base + int(done[s.index])
@@ -2227,13 +2338,15 @@ class SimServer:
                     "progress": req.progress,
                     "target": s.target,
                     "checkpoint": path,
+                    **({"parked": True} if urgent else {}),
                 }
             )
         runner._drain_io()
-        # the drained campaign's request-trace events must land durably NOW
-        # (this incarnation is about to exit — the gather is collective and
-        # every host reaches this drain path together)
-        self._flush_reqtrace(runner, key)
-        # the SIGTERM-drain incident ships with its timeline, like the
-        # standalone runner's preempt path
-        runner.incident_dump("drain")
+        if full_io:
+            # the drained campaign's request-trace events must land durably
+            # NOW (this incarnation is about to exit — the gather is
+            # collective and every host reaches this drain path together)
+            self._flush_reqtrace(runner, key)
+            # the SIGTERM-drain incident ships with its timeline, like the
+            # standalone runner's preempt path
+            runner.incident_dump("drain")

@@ -380,6 +380,152 @@ def assemble_request_trace(run_dir: str, request_id: str) -> dict | None:
     }
 
 
+def _fleet_sources(run_dir: str) -> list[tuple[str, str, str]]:
+    """Every journal-bearing lane of a fleet run as ``(lane, journal,
+    campaigns_dir)`` triples: the root journal (single-replica runs /
+    pre-fleet rows) plus one lane per ``replicas/<id>/`` subtree —
+    replicas AND proxies, whoever journaled the request's rows."""
+    sources = [
+        (
+            "root",
+            os.path.join(run_dir, "journal.jsonl"),
+            os.path.join(run_dir, "campaigns"),
+        )
+    ]
+    rroot = os.path.join(run_dir, "replicas")
+    for name in sorted(_listdir(rroot)):
+        sub = os.path.join(rroot, name)
+        if not os.path.isdir(sub):
+            continue  # heartbeat files (<id>.json) live beside the dirs
+        sources.append(
+            (
+                name,
+                os.path.join(sub, "journal.jsonl"),
+                os.path.join(sub, "campaigns"),
+            )
+        )
+    return sources
+
+
+def assemble_fleet_request_trace(run_dir: str, request_id: str) -> dict | None:
+    """Cross-replica request timeline: one Perfetto payload stitching the
+    rows every fleet process journaled about ``request_id`` — proxy
+    admission, each replica's scheduled/requeued/done lifecycle, and the
+    per-campaign chunk spans from whichever ``replicas/<rid>/campaigns``
+    subtree ran it.  Each journal source gets its own Perfetto process
+    lane (``pid``) named via metadata rows, so a request that migrated
+    across replicas (lease break, preemption, autoscale retire) renders
+    as a handoff between lanes.  None for an unknown request."""
+    from ..utils.journal import read_journal
+
+    sources = _fleet_sources(run_dir)
+    journals = [
+        (lane, read_journal(jpath, on_error="skip"), cdir)
+        for lane, jpath, cdir in sources
+    ]
+    tid = None
+    for _, journal, _ in journals:
+        tid = _journal_trace_id(journal, request_id)
+        if tid is not None:
+            break
+    tid = tid or _queue_trace_id(run_dir, request_id)
+    if tid is None:
+        return None
+    events: list[dict] = []
+    lanes: dict[int, str] = {}
+    merged: list[tuple[int, dict]] = []  # (lane_pid, row) across sources
+    for pid, (lane, journal, cdir) in enumerate(journals):
+        rows = [
+            r
+            for r in journal
+            if r.get("id") == request_id
+            and r.get("event") in _LIFECYCLE_EVENTS
+            and isinstance(r.get("t"), (int, float))
+        ]
+        chunk_events = []
+        for sub in sorted(_listdir(cdir)):
+            full = os.path.join(cdir, sub)
+            for name in sorted(_listdir(full)):
+                if not (name.startswith("trace_") and name.endswith(".json")):
+                    continue
+                try:
+                    with open(
+                        os.path.join(full, name), encoding="utf-8"
+                    ) as fh:
+                        payload = json.load(fh)
+                except (OSError, ValueError):
+                    continue
+                for ev in payload.get("traceEvents", ()):
+                    if (ev.get("args") or {}).get("trace_id") == tid:
+                        chunk_events.append({**ev, "pid": pid})
+        if not rows and not chunk_events:
+            continue  # lane never touched this request: no empty track
+        lanes[pid] = lane
+        events.extend(chunk_events)
+        for r in rows:
+            merged.append((pid, r))
+            args = {
+                k: v
+                for k, v in r.items()
+                if k not in ("event", "t", "wall_s") and _jsonable_scalar(v)
+            }
+            args["trace_id"] = tid
+            args["lane"] = lane
+            events.append(
+                {
+                    "name": r["event"],
+                    "ph": "i",
+                    "s": "g",
+                    "ts": round(r["t"] * 1e6, 1),
+                    "pid": pid,
+                    "tid": 0,
+                    "args": args,
+                }
+            )
+    # derived queued/running phases span lanes (admitted on a proxy,
+    # scheduled on a replica): derive over the time-merged row sequence,
+    # pin each phase to the lane of the row that OPENED it
+    merged.sort(key=lambda pr: pr[1]["t"])
+    mrows = [r for _, r in merged]
+    for i, (pid, r) in enumerate(merged):
+        if r["event"] in _QUEUE_OPENERS:
+            nxt = _next_of(mrows, i, ("request_scheduled",))
+            if nxt is not None:
+                events.append({**_phase("queued", tid, r["t"], nxt["t"]), "pid": pid})
+        elif r["event"] == "request_scheduled":
+            nxt = _next_of(mrows, i, _RUN_CLOSERS)
+            if nxt is not None:
+                events.append({**_phase("running", tid, r["t"], nxt["t"]), "pid": pid})
+    if not events:
+        return None
+    t0 = min(e["ts"] for e in events)
+    for e in events:
+        e["ts"] = round(e["ts"] - t0, 1)
+    events.sort(key=lambda e: e["ts"])
+    for pid, lane in sorted(lanes.items()):
+        events.insert(
+            0,
+            {
+                "name": "process_name",
+                "ph": "M",
+                "ts": 0.0,
+                "pid": pid,
+                "tid": 0,
+                "args": {"name": lane},
+            },
+        )
+    return {
+        "traceEvents": events,
+        "displayTimeUnit": "ms",
+        "otherData": {
+            "request_id": request_id,
+            "trace_id": tid,
+            "t0_unix": round(t0 / 1e6, 6),
+            "lanes": {str(p): n for p, n in sorted(lanes.items())},
+        },
+    }
+
+
 def _phase(name: str, tid: str, t0: float, t1: float) -> dict:
     return {
         "name": name,

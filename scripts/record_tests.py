@@ -112,6 +112,11 @@ def main() -> int:
         # spawned, leases broken, preemptions, zero-lost flag) — the
         # replicated front door gets the same tracked record
         "fleet": _fleet_counters(),
+        # autoscaling-controller counters from the autoscale129 chaos
+        # soak (decisions, spawn/retire counts, preemptions, admission
+        # p99, loss gates) — the control loop gets the same tracked
+        # record the fleet it drives has
+        "autoscale": _autoscale_counters(),
         # per-model solo-vs-ensemble parity deltas (workloads satellite):
         # recorded into PARITY.json too, so cross-model vmap/scan drift
         # shows up per-PR next to the Nu-parity numbers
@@ -285,6 +290,39 @@ def _fleet_counters() -> dict | None:
                 "error",
             )
             if key in fleet
+        }
+    except (OSError, ValueError, KeyError):
+        return None
+
+
+def _autoscale_counters() -> dict | None:
+    """Controller counters from BENCH_FULL.json's ``autoscale129`` row
+    (chaos soak: Poisson notice-SIGTERM/SIGKILL preemptions against an
+    autoscaled fleet): decisions taken, replicas spawned/retired,
+    preemption mix, admission p99 and the zero-lost /
+    reclaimed-with-state / SLO gates.  None when the config was never
+    benched — or predates the autoscaler."""
+    try:
+        with open(os.path.join(_REPO, "BENCH_FULL.json")) as f:
+            row = json.load(f)["results"]["autoscale129"]
+        return {
+            key: row.get(key)
+            for key in (
+                "requests",
+                "decisions",
+                "spawned",
+                "retired",
+                "preempts_notice",
+                "preempts_kill",
+                "resumed_mid_flight",
+                "admission_p50_s",
+                "admission_p99_s",
+                "zero_lost",
+                "reclaimed_with_state",
+                "slo_ok",
+                "error",
+            )
+            if key in row
         }
     except (OSError, ValueError, KeyError):
         return None

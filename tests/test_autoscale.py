@@ -1,0 +1,621 @@
+"""Autoscaler tests (rustpde_mpi_tpu/serve/fleet/autoscaler.py +
+launcher.py): the control law against a fake launcher with injected
+clocks (no subprocesses, no device work), torn-heartbeat tolerance, the
+jittered Retry-After, the proxy's bearer-token gate and cross-replica
+trace endpoint, the preemption-notice urgent drain, the lease-break vs
+scale-in fencing race, and the autoscale-off invariant.
+
+The full chaos soak (controller + real replica subprocesses under
+Poisson preemptions) lives in the slow tier at the bottom.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from rustpde_mpi_tpu.config import (
+    AutoscaleConfig,
+    FleetConfig,
+    ServeConfig,
+)
+from rustpde_mpi_tpu.serve import (
+    AdmissionError,
+    DurableQueue,
+    FleetProxy,
+    LeaseManager,
+    SimRequest,
+    SimServer,
+)
+from rustpde_mpi_tpu.serve.fleet import Autoscaler, ReplicaHandle, ReplicaLauncher
+from rustpde_mpi_tpu.serve.fleet.lease import LeaseLost
+from rustpde_mpi_tpu.serve.fleet.proxy import (
+    read_replica_status,
+    write_replica_heartbeat,
+)
+from rustpde_mpi_tpu.serve.http_front import rejection_payload, seed_retry_jitter
+from rustpde_mpi_tpu.utils.journal import read_journal
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_REQ = dict(ra=1e4, pr=1.0, nx=17, ny=17, dt=0.01, horizon=0.1, bc="rbc")
+_KEY = SimRequest(**_REQ).compat_key
+
+
+class Clock:
+    """Injectable monotonic/wall clock the control-law tests advance by
+    hand — sustain windows and cooldowns become deterministic."""
+
+    def __init__(self):
+        self.t = time.monotonic()
+
+    def __call__(self):
+        return self.t
+
+    def tick(self, dt):
+        self.t += dt
+
+
+class FakeLauncher(ReplicaLauncher):
+    """In-memory backend: spawns are ledger entries, retire/kill are
+    recorded signals — the control law is the thing under test."""
+
+    def __init__(self):
+        self._handles = {}
+        self.retired_ids = []
+        self.killed_ids = []
+
+    def handles(self):
+        return list(self._handles.values())
+
+    def spawn(self, replica_id):
+        h = ReplicaHandle(replica_id=replica_id, pid=1000 + len(self._handles))
+        self._handles[replica_id] = h
+        return h
+
+    def retire(self, handle):
+        handle.retired = True
+        self.retired_ids.append(handle.replica_id)
+
+    def kill(self, handle):
+        handle.retired = True
+        self.killed_ids.append(handle.replica_id)
+
+    def alive(self, handle):
+        return not getattr(handle, "dead", False)
+
+    def reap(self):
+        gone = [h for h in self._handles.values() if not self.alive(h)]
+        for h in gone:
+            del self._handles[h.replica_id]
+        return gone
+
+
+def _controller(tmp_path, cfg, clock=None, launcher=None):
+    clock = clock or Clock()
+    launcher = launcher or FakeLauncher()
+    asc = Autoscaler(
+        str(tmp_path / "fleet"),
+        launcher,
+        cfg,
+        controller_id="asc-test",
+        mono=clock,
+        wall=time.time,
+    )
+    return asc, launcher, clock
+
+
+def _decisions(run_dir):
+    return read_journal(
+        os.path.join(run_dir, "replicas", "asc-test", "journal.jsonl")
+    )
+
+
+# -- the control law (fake launcher, injected clocks) --------------------------
+
+
+def test_autoscaler_sustained_queue_depth_scales_out(tmp_path):
+    """Queue depth must be HIGH for sustain_s before elective scale-out
+    fires; the spawned replica counts as pending capacity (spawn grace),
+    and the cooldown holds the next elective action."""
+    run_dir = str(tmp_path / "fleet")
+    cfg = AutoscaleConfig(
+        min_replicas=0, max_replicas=3, queue_high=2, sustain_s=5.0,
+        cooldown_s=30.0,
+    )
+    asc, launcher, clock = _controller(tmp_path, cfg)
+    q = DurableQueue(os.path.join(run_dir, "queue"), max_queue=64)
+    for s in range(4):
+        q.submit(SimRequest(**_REQ, seed=s))
+    d = asc.step()
+    assert (d["action"], d["reason"]) == ("hold", "pressure_building")
+    clock.tick(3.0)
+    assert asc.step()["action"] == "hold"  # 3s < sustain_s
+    clock.tick(3.0)
+    d = asc.step()
+    assert (d["action"], d["reason"]) == ("scale_out", "queue_depth")
+    assert len(launcher.handles()) == 1
+    # the fresh spawn is pending capacity: no heartbeat yet, still counted
+    clock.tick(1.0)
+    d = asc.step()
+    assert d["action"] == "hold" and d["pending"] == 1
+    assert d["reason"] in ("cooldown", "pressure_building")
+    # cooldown gates the NEXT elective scale-out even with pressure held
+    clock.tick(10.0)
+    d = asc.step()
+    assert (d["action"], d["reason"]) == ("hold", "cooldown")
+    clock.tick(30.0)
+    assert asc.step()["action"] == "scale_out"
+    assert asc.stats()["spawned"] == 2
+    asc.stop()
+
+
+def test_autoscaler_deadline_slack_scales_out_without_sustain(tmp_path):
+    """A queued request whose deadline slack is under slack_low_s is an
+    emergency: scale-out on the FIRST evaluation, no sustain window."""
+    run_dir = str(tmp_path / "fleet")
+    cfg = AutoscaleConfig(
+        min_replicas=0, max_replicas=2, queue_high=50, slack_low_s=30.0
+    )
+    asc, launcher, _ = _controller(tmp_path, cfg)
+    q = DurableQueue(os.path.join(run_dir, "queue"), max_queue=64)
+    q.submit(SimRequest(**_REQ, seed=0, deadline_s=10.0))
+    d = asc.step()
+    assert (d["action"], d["reason"]) == ("scale_out", "deadline_slack")
+    assert d["min_slack_s"] is not None and d["min_slack_s"] < 30.0
+    assert len(launcher.handles()) == 1
+    asc.stop()
+
+
+def test_autoscaler_below_min_repair_is_immediate_and_cooldown_exempt(tmp_path):
+    """Capacity repair after a preemption: a dead replica under the floor
+    is replaced on the next evaluation even inside the cooldown."""
+    cfg = AutoscaleConfig(min_replicas=1, max_replicas=3, cooldown_s=3600.0)
+    asc, launcher, clock = _controller(tmp_path, cfg)
+    d = asc.step()
+    assert (d["action"], d["reason"]) == ("scale_out", "below_min")
+    h = launcher.handles()[0]
+    # preemption: the replica dies hard; reap + repair on the next step
+    h.dead = True
+    clock.tick(1.0)
+    d = asc.step()
+    assert (d["action"], d["reason"]) == ("scale_out", "below_min")
+    assert asc.stats()["spawned"] == 2
+    asc.stop()
+
+
+def test_autoscaler_idle_scale_in_drains_fewest_occupied_victim(tmp_path):
+    """Scale-in fires only after a SUSTAINED fully-idle window, picks the
+    launcher-owned fresh replica with the fewest occupied slots, and
+    retires it through the launcher (SIGTERM semantics — the replica's
+    own park-and-release drain does the work)."""
+    run_dir = str(tmp_path / "fleet")
+    cfg = AutoscaleConfig(
+        min_replicas=1, max_replicas=3, idle_sustain_s=10.0, cooldown_s=0.0
+    )
+    asc, launcher, clock = _controller(tmp_path, cfg)
+    launcher.spawn("auto-a")
+    launcher.spawn("auto-b")
+    write_replica_heartbeat(run_dir, "auto-a", {"slots": [2, 2]})
+    write_replica_heartbeat(run_dir, "auto-b", {"slots": [0, 2]})
+    d = asc.step()
+    assert (d["action"], d["reason"]) == ("hold", "idle_building")
+    clock.tick(11.0)
+    d = asc.step()
+    assert (d["action"], d["reason"]) == ("scale_in", "idle")
+    assert d["victim"] == "auto-b"  # fewest occupied slots drains cheapest
+    assert launcher.retired_ids == ["auto-b"]
+    assert launcher.killed_ids == []  # retirement is never a SIGKILL
+    # the drained victim reports stopping: it leaves fresh capacity, and
+    # the floor (capacity == min_replicas) blocks further scale-in
+    clock.tick(11.0)
+    write_replica_heartbeat(run_dir, "auto-a", {"slots": [0, 2]})
+    write_replica_heartbeat(run_dir, "auto-b", {"stopping": True})
+    d = asc.step()
+    assert d["action"] == "hold"
+    asc.stop()
+    rows = _decisions(run_dir)
+    retired = [r for r in rows if r["event"] == "replica_retired"]
+    assert retired and retired[0]["replica"] == "auto-b"
+
+
+def test_autoscaler_holds_at_max_and_journals_transitions_once(tmp_path):
+    """Bounds: sustained pressure at max_replicas holds with reason
+    at_max.  Hold verdicts journal only on TRANSITION — a steady
+    controller must not grow the journal without bound."""
+    run_dir = str(tmp_path / "fleet")
+    cfg = AutoscaleConfig(
+        min_replicas=0, max_replicas=1, queue_high=1, sustain_s=0.0,
+        cooldown_s=0.0,
+    )
+    asc, launcher, clock = _controller(tmp_path, cfg)
+    q = DurableQueue(os.path.join(run_dir, "queue"), max_queue=64)
+    for s in range(3):
+        q.submit(SimRequest(**_REQ, seed=s))
+    clock.tick(1.0)
+    assert asc.step()["action"] == "scale_out"
+    for _ in range(5):  # at max, pressure still high: identical holds
+        clock.tick(1.0)
+        d = asc.step()
+        assert (d["action"], d["reason"]) == ("hold", "at_max")
+    asc.stop()
+    rows = _decisions(run_dir)
+    decisions = [r for r in rows if r["event"] == "autoscale_decision"]
+    at_max = [r for r in decisions if r["reason"] == "at_max"]
+    assert len(at_max) == 1, "repeated identical holds must journal once"
+    assert [r["event"] for r in rows].count("replica_spawned") == 1
+
+
+# -- torn heartbeats (satellite) -----------------------------------------------
+
+
+def test_read_replica_status_tolerates_torn_heartbeat(tmp_path):
+    """Regression: a torn/truncated heartbeat JSON is a SICK replica, not
+    a missing one — stale+torn entry with a warning, while intact peers
+    read normally and non-heartbeat files stay ignored."""
+    run_dir = str(tmp_path / "fleet")
+    write_replica_heartbeat(run_dir, "rA", {"draining": False})
+    torn = os.path.join(run_dir, "replicas", "rB.json")
+    with open(torn, "w", encoding="utf-8") as fh:
+        fh.write('{"replica": "rB", "hb_un')  # writer died mid-record
+    with open(os.path.join(run_dir, "replicas", "notes.txt"), "w") as fh:
+        fh.write("not a heartbeat")
+    with pytest.warns(RuntimeWarning, match="torn replica heartbeat"):
+        status = read_replica_status(run_dir, ttl_s=60.0)
+    assert [r["replica"] for r in status] == ["rA", "rB"]
+    assert status[0]["stale"] is False and "torn" not in status[0]
+    assert status[1]["stale"] is True and status[1]["torn"] is True
+    # the autoscaler counts the torn replica as NOT fresh capacity
+    asc = Autoscaler(
+        run_dir, FakeLauncher(), AutoscaleConfig(), controller_id="asc-test"
+    )
+    with pytest.warns(RuntimeWarning):
+        obs = asc.observe()
+    assert obs["alive"] == 1 and "rB" not in obs["replicas"]
+    asc.stop()
+
+
+# -- jittered Retry-After (satellite) ------------------------------------------
+
+
+def test_retry_after_jitter_deterministic_and_depth_scaled():
+    exc = AdmissionError("queue_full", "full", retry_after_s=5.0)
+    seed_retry_jitter(42)
+    first = [rejection_payload(exc, 10) for _ in range(4)]
+    seed_retry_jitter(42)
+    second = [rejection_payload(exc, 10) for _ in range(4)]
+    assert first == second  # deterministic under a pinned seed
+    for payload, headers in first:
+        assert payload["retry_after_s"] >= 1
+        assert int(headers["Retry-After"]) == payload["retry_after_s"]
+    # jitter actually varies within a seeded stream
+    assert len({p["retry_after_s"] for p, _ in first}) > 1
+    # deeper queues push the advice up (same draw, bigger base)
+    seed_retry_jitter(7)
+    shallow, _ = rejection_payload(exc, 0)
+    seed_retry_jitter(7)
+    deep, _ = rejection_payload(exc, 200)
+    assert deep["retry_after_s"] > shallow["retry_after_s"]
+    # the floor survives jitter: tiny base, many draws, never below 1
+    tiny = AdmissionError("quota", "q", retry_after_s=0.2)
+    seed_retry_jitter(3)
+    assert all(
+        rejection_payload(tiny, 0)[0]["retry_after_s"] >= 1 for _ in range(50)
+    )
+
+
+# -- proxy bearer-token gate (PR 15 leftover) ----------------------------------
+
+
+def _post(base, payload, token=None):
+    headers = {}
+    if token is not None:
+        headers["Authorization"] = f"Bearer {token}"
+    req = urllib.request.Request(
+        base + "/requests",
+        data=json.dumps(payload).encode(),
+        method="POST",
+        headers=headers,
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            return resp.status, json.loads(resp.read()), dict(resp.headers)
+    except urllib.error.HTTPError as err:
+        return err.code, json.loads(err.read()), dict(err.headers)
+
+
+def test_proxy_auth_tokens_gate_mutations_only(tmp_path):
+    run_dir = str(tmp_path / "fleet")
+    proxy = FleetProxy(
+        run_dir, max_queue=8, fleet=FleetConfig(replica_id="p1"),
+        auth_tokens=["sekrit", "other"],
+    )
+    proxy.start()
+    try:
+        host, port = proxy.address
+        base = f"http://{host}:{port}"
+        # no credentials: 401 auth_missing with a challenge header
+        code, body, headers = _post(base, dict(_REQ, seed=0))
+        assert code == 401 and body["reason"] == "auth_missing"
+        assert headers["WWW-Authenticate"] == "Bearer"
+        # wrong token: 403 auth_invalid
+        code, body, _ = _post(base, dict(_REQ, seed=0), token="wrong")
+        assert code == 403 and body["reason"] == "auth_invalid"
+        # either allowlisted token admits
+        assert _post(base, dict(_REQ, seed=0), token="sekrit")[0] == 202
+        assert _post(base, dict(_REQ, seed=1), token="other")[0] == 202
+        # reads stay open: orchestrator probes carry no secrets
+        with urllib.request.urlopen(base + "/healthz", timeout=30) as resp:
+            assert resp.status == 200
+        with urllib.request.urlopen(base + "/stats", timeout=30) as resp:
+            assert resp.status == 200
+        # both rejections journaled with their typed reasons
+        rows = read_journal(
+            os.path.join(run_dir, "replicas", "proxy-p1", "journal.jsonl")
+        )
+        reasons = [
+            r["reason"] for r in rows if r["event"] == "auth_rejected"
+        ]
+        assert reasons == ["auth_missing", "auth_invalid"]
+    finally:
+        proxy.stop()
+
+
+def test_proxy_auth_defaults_from_env_knob(tmp_path, monkeypatch):
+    monkeypatch.setenv("RUSTPDE_PROXY_TOKENS", "tokA, tokB")
+    proxy = FleetProxy(str(tmp_path / "fleet"), max_queue=8)
+    assert proxy.auth_tokens == ("tokA", "tokB")
+    proxy._httpd.server_close()
+    monkeypatch.setenv("RUSTPDE_PROXY_TOKENS", "")
+    open_proxy = FleetProxy(str(tmp_path / "fleet2"), max_queue=8)
+    assert open_proxy.auth_tokens == ()
+    open_proxy._httpd.server_close()
+
+
+# -- cross-replica trace assembly (PR 15 leftover) -----------------------------
+
+
+def _cfg(tmp_path, **kw):
+    kw.setdefault("run_dir", str(tmp_path / "fleet"))
+    kw.setdefault("slots", 2)
+    kw.setdefault("chunk_steps", 4)
+    kw.setdefault("checkpoint_every_s", None)
+    kw.setdefault("http_port", None)
+    return ServeConfig(**kw)
+
+
+def test_proxy_trace_endpoint_stitches_replica_journals(tmp_path):
+    """GET /requests/<id>/trace on the proxy assembles the timeline from
+    the replica's journal under replicas/rA/ — per-source Perfetto lanes,
+    lifecycle instants, derived queued/running phases."""
+    run_dir = str(tmp_path / "fleet")
+    srv = SimServer(_cfg(tmp_path, fleet=FleetConfig(replica_id="rA")))
+    req = srv.submit(dict(_REQ, seed=0))
+    summary = srv.serve()
+    assert summary["completed"] == 1
+    proxy = FleetProxy(run_dir, max_queue=8, fleet=FleetConfig(replica_id="p1"))
+    proxy.start()
+    try:
+        host, port = proxy.address
+        base = f"http://{host}:{port}"
+        with urllib.request.urlopen(
+            f"{base}/requests/{req.id}/trace", timeout=30
+        ) as resp:
+            assert resp.status == 200
+            payload = json.loads(resp.read())
+        names = [e["name"] for e in payload["traceEvents"]]
+        assert "request_admitted" in names and "request_done" in names
+        assert "running" in names  # derived phase span
+        assert "rA" in payload["otherData"]["lanes"].values()
+        # rows carry the lane that journaled them
+        lanes = {
+            e["args"]["lane"]
+            for e in payload["traceEvents"]
+            if e["ph"] == "i" and "lane" in e.get("args", {})
+        }
+        assert lanes == {"rA"}
+        # unknown ids 404
+        try:
+            urllib.request.urlopen(f"{base}/requests/nope/trace", timeout=30)
+            raise AssertionError("expected 404")
+        except urllib.error.HTTPError as err:
+            assert err.code == 404
+    finally:
+        proxy.stop()
+
+
+# -- preemption notice: urgent park-everything drain ---------------------------
+
+
+def test_preempt_notice_sigterm_parks_and_releases(tmp_path, monkeypatch):
+    """RUSTPDE_PREEMPT_NOTICE_S armed: SIGTERM mid-campaign takes the
+    URGENT drain — running slots park as durable continuations with
+    progress, requeue rows carry parked=True (no full checkpoint), a
+    preempt_notice row lands, leases release — and a second replica
+    resumes the parked request mid-flight to completion."""
+    monkeypatch.setenv("RUSTPDE_PREEMPT_NOTICE_S", "20")
+    run_dir = str(tmp_path / "fleet")
+    srv = SimServer(
+        _cfg(tmp_path, slots=1,
+             fleet=FleetConfig(replica_id="rA", lease_ttl_s=60.0))
+    )
+    req = srv.submit(dict(_REQ, seed=0, horizon=5.0))
+
+    def fire():
+        while srv.stats()["member_steps"] < 8:
+            time.sleep(0.05)
+        os.kill(os.getpid(), signal.SIGTERM)
+
+    t = threading.Thread(target=fire)
+    t.start()
+    summary = srv.serve()  # main thread: owns the signal handlers
+    t.join()
+    assert summary["completed"] == 0 and summary["failed"] == 0
+    events = read_journal(
+        os.path.join(run_dir, "replicas", "rA", "journal.jsonl")
+    )
+    names = [e["event"] for e in events]
+    assert "preempt_notice" in names
+    notice = next(e for e in events if e["event"] == "preempt_notice")
+    assert notice["notice_s"] == 20.0 and notice["remaining_s"] > 0
+    parked = [
+        e for e in events
+        if e["event"] == "request_requeued" and e.get("parked")
+    ]
+    assert parked and parked[0]["id"] == req.id
+    assert parked[0].get("checkpoint") is None  # urgent: no full ckpt
+    persisted = [
+        e for e in events
+        if e["event"] == "continuation_persisted" and e.get("steps", 0) > 0
+    ]
+    assert persisted, "urgent drain must park running slots durably"
+    # leases released: nothing left for a survivor to break
+    leases = os.listdir(os.path.join(run_dir, "queue", "leases"))
+    assert [n for n in leases if n.endswith(".json")] == []
+    # the request is back in the queue with its progress intact
+    q = DurableQueue(os.path.join(run_dir, "queue"), max_queue=8)
+    assert q.counts()["queued"] == 1
+    monkeypatch.delenv("RUSTPDE_PREEMPT_NOTICE_S")
+    survivor = SimServer(
+        _cfg(tmp_path, fleet=FleetConfig(replica_id="rB", lease_ttl_s=60.0))
+    )
+    summary2 = survivor.serve()
+    assert summary2["completed"] == 1 and summary2["failed"] == 0
+    ev2 = read_journal(
+        os.path.join(run_dir, "replicas", "rB", "journal.jsonl")
+    )
+    resumed = [
+        e for e in ev2
+        if e["event"] == "continuation_resumed" and e.get("steps", 0) > 0
+    ]
+    assert resumed, "survivor must resume mid-flight from the parked state"
+
+
+# -- lease break racing autoscaler scale-in (satellite) ------------------------
+
+
+def test_lease_break_races_scale_in_drain_fencing_order(tmp_path):
+    """The race the autoscaler's scale-in opens: the victim is draining
+    (its lease heartbeat already stopped) while a survivor's sweep breaks
+    the same lease.  Whoever wins, fencing tokens stay strictly
+    monotonic: the broken victim's release/renew/guard all raise
+    LeaseLost, and the re-claim sees a strictly newer token — so a
+    stalled drain write can never land over the new owner's claim."""
+    root = str(tmp_path / "leases")
+    victim_mgr = LeaseManager(root, "victim", ttl_s=0.1)
+    survivor = LeaseManager(root, "survivor", ttl_s=0.1)
+    lease = victim_mgr.claim(_KEY)
+    assert lease.token == 1
+    survivor.stale(lease.tag)  # open the observation window
+    time.sleep(0.15)  # the draining victim stops heartbeating
+    assert survivor.stale(lease.tag) is True
+    broken = survivor.break_lease(lease.tag)
+    assert broken is not None and broken["owner"] == "victim"
+    # the victim's drain finally reaches its release: FENCED, not a crash
+    with pytest.raises(LeaseLost):
+        lease.release()
+    with pytest.raises(LeaseLost):
+        lease.guard()
+    # the reclaim is strictly newer than every token the victim ever held
+    relcaim = survivor.claim(_KEY)
+    assert relcaim.token == 2 > broken["token"]
+    relcaim.guard()
+    # mirror race, other order: a clean release FIRST, then no break left
+    relcaim.release()
+    assert survivor.break_lease(relcaim.tag) is None
+
+
+# -- the off switch: autoscale=None is byte-identical --------------------------
+
+
+def test_autoscale_off_adds_nothing(tmp_path):
+    """ServeConfig.autoscale defaults to None: no controller thread, no
+    autoscale_* journal rows, no controller journal dir, no autoscale
+    stats key — fleet serving byte-identical to PR 15."""
+    assert ServeConfig().autoscale is None  # the default IS off
+    run_dir = str(tmp_path / "fleet")
+    srv = SimServer(_cfg(tmp_path, fleet=FleetConfig(replica_id="rA")))
+    srv.submit(dict(_REQ, seed=0))
+    seen_threads = set()
+    done_evt = threading.Event()
+
+    def watch():
+        while not done_evt.is_set():
+            seen_threads.update(t.name for t in threading.enumerate())
+            time.sleep(0.02)
+
+    t = threading.Thread(target=watch)
+    t.start()
+    summary = srv.serve()
+    done_evt.set()
+    t.join()
+    assert summary["completed"] == 1
+    assert "fleet-autoscale" not in seen_threads
+    assert "autoscale" not in summary["fleet"]
+    events = read_journal(
+        os.path.join(run_dir, "replicas", "rA", "journal.jsonl")
+    )
+    assert [e for e in events if e["event"].startswith("autoscale")] == []
+    assert [e for e in events if e["event"] == "preempt_notice"] == []
+    dirs = os.listdir(os.path.join(run_dir, "replicas"))
+    assert [d for d in dirs if d.startswith("autoscaler")] == []
+
+
+# -- chaos soak: autoscaled fleet under Poisson preemptions (slow tier) --------
+
+
+@pytest.mark.slow
+def test_autoscale_chaos_soak_preemptions_loss_free(tmp_path):
+    """The acceptance gate: the standalone controller scales a real
+    replica fleet for a seeded backlog while the chaos schedule preempts
+    replicas (notice-SIGTERM + hard SIGKILL mix) — every request reaches
+    done, zero failed, and at least one request was reclaimed WITH state
+    (continuation_resumed steps > 0 in some replica's journal)."""
+    run_dir = str(tmp_path / "fleet")
+    os.makedirs(run_dir, exist_ok=True)
+    env = dict(os.environ, JAX_PLATFORMS="cpu", RUSTPDE_X64="1")
+    env.pop("RUSTPDE_FAULT", None)
+    res = subprocess.run(
+        [
+            sys.executable,
+            os.path.join(_REPO, "examples", "navier_rbc_autoscale.py"),
+            "--run-dir", run_dir, "--requests", "4", "--seed", "7",
+            "--horizon", "1.5",
+            "--min-replicas", "1", "--max-replicas", "2",
+            "--queue-high", "1", "--sustain-s", "1", "--cooldown-s", "2",
+            "--decide-s", "0.5", "--notice-s", "8",
+            "--lease-ttl-s", "3", "--heartbeat-s", "0.2",
+            "--chunk-steps", "8",
+            "--chaos-preempts", "2", "--chaos-kill-frac", "0.5",
+            "--chaos-mean-gap-s", "1",
+        ],
+        capture_output=True, text=True, env=env, cwd=_REPO, timeout=1200,
+    )
+    assert res.returncode == 0, res.stderr[-2500:]
+    lines = [json.loads(x) for x in res.stdout.splitlines() if x.startswith("{")]
+    final = lines[-1]
+    assert final["outcome"] == "done" and final["spawned"] >= 1
+    assert final["notice"] + final["kill"] >= 1, "chaos never fired"
+    counts = DurableQueue(
+        os.path.join(run_dir, "queue"), max_queue=64
+    ).counts()
+    assert counts == {"queued": 0, "running": 0, "done": 4, "failed": 0}
+    # reclaimed WITH state: some replica resumed a parked continuation
+    resumed = []
+    rroot = os.path.join(run_dir, "replicas")
+    for name in os.listdir(rroot):
+        jpath = os.path.join(rroot, name, "journal.jsonl")
+        if not os.path.isfile(jpath):
+            continue
+        resumed += [
+            e for e in read_journal(jpath, on_error="skip")
+            if e["event"] == "continuation_resumed" and e.get("steps", 0) > 0
+        ]
+    assert resumed, "no request was ever reclaimed mid-flight"

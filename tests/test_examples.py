@@ -43,6 +43,13 @@ _CASES = {
     "navier_rbc_fleet.py": [
         "--replica", "--replica-id", "smoke", "--run-dir", "data/fleet_smoke",
     ],
+    # controller-only autoscale pass: three decide ticks over an empty
+    # queue with a zero floor — exercises observe/decide/journal without
+    # spawning replica subprocesses (each would pay a full JAX import)
+    "navier_rbc_autoscale.py": [
+        "--run-dir", "data/autoscale_smoke", "--min-replicas", "0",
+        "--max-replicas", "1", "--steps", "3", "--decide-s", "0.05",
+    ],
     "navier_lnse_eigenmodes.py": ["--quick", "--run-dir", "data/eig_smoke"],
     "navier_mpi.py": ["--quick"],
     "navier_rbc_steady.py": ["--quick"],
