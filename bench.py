@@ -74,6 +74,7 @@ DEFAULT_CONFIGS = [
     "shardedio129",
     "serve129",
     "autoscale129",
+    "serve_submesh129",
     "workloads129",
     "stats129",
     "pallasconv",
@@ -105,6 +106,7 @@ METRIC_NAMES = {
     "shardedio129": "2D RBC sharded two-phase checkpoints, 2-proc CPU harness (sharded vs gathered write + elastic-restore gate)",
     "serve129": "2D RBC simulation service 129x129 Ra=1e7, 200 requests / 8 slots soak (drain+NaN chaos; member-steps/s + latency percentiles)",
     "autoscale129": "autoscaling fleet chaos soak 17x17 CPU (controller + launcher under Poisson notice-SIGTERM/SIGKILL preemptions; zero-lost + reclaimed-with-state + admission p99 gates)",
+    "serve_submesh129": "gang-scheduled sub-mesh serving chaos soak, 2-proc CPU harness (34^2 gang-sharded + 18^2 vmapped co-resident traffic; gang-member SIGKILL mid-campaign: zero-lost + gang-reclaimed-with-state + rtol-1e-9 solo parity + co-resident latency gates)",
     "workloads129": "multi-model workloads 129x129 (dns/lnse/adjoint member-steps/s per kind + solo-vs-ensemble parity + lnse onset-sign gate)",
     "stats129": "2D RBC confined 129x129 Ra=1e7 in-scan physics stats (stats-on vs stats-off matched governed windows: bit-equal trajectory + <=5% overhead + budget-closure gates)",
     "pallasconv": "fused Pallas convection + solve megakernels vs unfused dense (RUSTPDE_CONV_KERNEL / RUSTPDE_STEP_KERNEL A/B: ms/step + MFU + bit-tolerance + HBM-traffic deltas; 129x129 min, flagship rows on-chip)",
@@ -1259,6 +1261,228 @@ def bench_autoscale(timeout_s=1200):
         shutil.rmtree(run_dir, ignore_errors=True)
 
 
+def bench_serve_submesh(timeout_s=900):
+    """serve_submesh129: the two-level gang-scheduled serving leg (PR 18).
+
+    Mixed traffic on the 2-process CPU harness (tests/mp_worker's
+    ``gang_serve`` mode): the fleet's 4 devices are carved into a
+    2-device cross-process gang slice serving 34^2 SHARDED requests and
+    a 2-device default remainder serving 18^2 vmapped requests, plus an
+    in-worker probe that an unservable 259^2 request is a typed
+    ``no_submesh`` rejection at the door.  Two runs: a clean BASELINE,
+    then a CHAOS pair — one gang member SIGKILLed mid-sharded-chunk
+    (``kill@10:gang0member1``: past the second chunk boundary, where the
+    two-phase writer has COMMITTED the step-4 cadence checkpoint — a
+    kill inside the first deferred-commit window leaves nothing
+    restorable and the finisher would replay from scratch), then a clean
+    finisher incarnation that re-forms the gang and restores the broken
+    gang's surviving trajectory mid-flight from that checkpoint.
+
+    Gates (folded into ``finite``): zero_lost on both runs,
+    gang_killed (the fault fired and BOTH ranks exited nonzero —
+    fate-sharing, no wedge), gang_reclaimed (typed ``gang_member_lost``
+    containment + trajectories restored mid-flight), solo_ok (EVERY
+    chaos done record matches an f64 solo serial rerun to rtol 1e-9 —
+    both grid classes, including the reclaimed gang trajectories), and
+    coresident_ok (no vmapped request swept into the gang containment
+    requeue, and the 18^2 bucket's latency p99 within a loose CPU-tier
+    factor of baseline: gang death must not stall co-resident
+    buckets)."""
+    import shutil
+    import subprocess
+    import tempfile
+
+    import numpy as np
+
+    from rustpde_mpi_tpu.utils.journal import read_journal
+
+    n_gang = int(os.environ.get("RUSTPDE_GANG_BENCH_REQUESTS", "2"))
+    n_vmap = max(2, n_gang)
+    base_env = {
+        "RUSTPDE_MP_GANG_REQUESTS": str(n_gang),
+        "RUSTPDE_MP_VMAP_REQUESTS": str(n_vmap),
+        "RUSTPDE_MP_SERVE_SLOTS": "2",
+        "RUSTPDE_SYNC_TIMEOUT_S": "60",
+        "RUSTPDE_DISPATCH_TIMEOUT_S": "60",
+        "RUSTPDE_GANG_SYNC_TIMEOUT_S": "30",
+        "RUSTPDE_SANITIZE": "1",
+    }
+    sys.path.insert(0, os.path.join(_REPO, "tests"))
+    from mp_harness import spawn_cluster
+
+    n_all = n_gang + n_vmap
+
+    def records_of(out_dir):
+        done_dir = os.path.join(out_dir, "serve", "queue", "done")
+        recs = []
+        for name in sorted(os.listdir(done_dir)):
+            with open(os.path.join(done_dir, name)) as fh:
+                recs.append(json.load(fh))
+        return recs
+
+    def result_of(out_dir):
+        with open(os.path.join(out_dir, "result.json")) as fh:
+            return json.load(fh)
+
+    def zero_lost(r):
+        return r["queue"] == {
+            "queued": 0, "running": 0, "done": n_all, "failed": 0
+        }
+
+    def vmap_p99(recs):
+        lat = sorted(
+            r["result"]["latency_s"]
+            for r in recs
+            if int(r["request"]["nx"]) == 18
+        )
+        return float(lat[min(len(lat) - 1, int(0.99 * len(lat)))]) if lat else None
+
+    base_dir = tempfile.mkdtemp(prefix="bench_submesh_base_")
+    chaos_dir = tempfile.mkdtemp(prefix="bench_submesh_chaos_")
+    try:
+        # baseline: clean mixed traffic end to end
+        t0 = time.perf_counter()
+        outs = spawn_cluster(
+            base_dir, mode="gang_serve", timeout=timeout_s, check=True,
+            env_extra=base_env,
+        )
+        if outs is None:
+            raise RuntimeError("submesh baseline spawn timed out")
+        base_wall = time.perf_counter() - t0
+        base_r = result_of(base_dir)
+        base_recs = records_of(base_dir)
+        base_p99 = vmap_p99(base_recs)
+
+        # chaos: gang member 1 SIGKILLed mid-gang-campaign (fate-sharing:
+        # both ranks must exit nonzero), then a clean finisher reclaims
+        t1 = time.perf_counter()
+        outs = spawn_cluster(
+            chaos_dir, mode="gang_serve", timeout=timeout_s, check=False,
+            env_extra={**base_env, "RUSTPDE_FAULT": "kill@10:gang0member1"},
+        )
+        if outs is None:
+            raise RuntimeError("submesh chaos spawn timed out")
+        gang_killed = all(o[0] != 0 for o in outs)
+        outs = spawn_cluster(
+            chaos_dir, mode="gang_serve", timeout=timeout_s, check=True,
+            env_extra=base_env,
+        )
+        if outs is None:
+            raise RuntimeError("submesh finisher spawn timed out")
+        chaos_wall = time.perf_counter() - t1
+        chaos_r = result_of(chaos_dir)
+        chaos_recs = records_of(chaos_dir)
+        chaos_p99 = vmap_p99(chaos_recs)
+
+        # solo equivalence (rtol 1e-9) over EVERY chaos done record: f64
+        # serial rerun per record in a subprocess (the harness pins
+        # RUSTPDE_X64=1, so the solo shadow must match that precision)
+        env = dict(os.environ, JAX_PLATFORMS="cpu", RUSTPDE_X64="1")
+        env.pop("RUSTPDE_FAULT", None)
+        iso_diffs = []
+        for rec in chaos_recs:
+            req, res = rec["request"], rec["result"]
+            code = (
+                "from rustpde_mpi_tpu import Navier2D; "
+                f"m = Navier2D({req['nx']},{req['ny']},{req['ra']},"
+                f"{req['pr']},{res['dt']},1.0,'{req.get('bc') or 'rbc'}',"
+                "periodic=False); "
+                f"m.init_random({res['amp'] or 0.1}, seed={res['seed']}); "
+                f"m.update_n({res['steps']}); print(float(m.eval_nu()))"
+            )
+            out = subprocess.run(
+                [sys.executable, "-c", code], capture_output=True, text=True,
+                timeout=900, env=env, cwd=_REPO,
+            )
+            solo = float(out.stdout.strip().splitlines()[-1])
+            iso_diffs.append(abs(res["nu"] - solo) / max(abs(solo), 1e-30))
+
+        # containment scope: the gang's requeue rows must reference ONLY
+        # the gang bucket — a vmapped id in a gang-tagged requeue means
+        # the failure domain leaked into a co-resident bucket
+        vmap_ids = {
+            r["request"]["id"]
+            for r in chaos_recs
+            if int(r["request"]["nx"]) == 18
+        }
+        gang_requeues = [
+            e
+            for e in read_journal(
+                os.path.join(chaos_dir, "serve", "journal.jsonl"),
+                on_error="skip",
+            )
+            if e.get("event") == "request_requeued"
+            and e.get("gang") is not None
+        ]
+        coresident_isolated = not any(
+            e.get("id") in vmap_ids for e in gang_requeues
+        )
+        # loose CPU-tier bound: the chaos pair includes a full restart
+        # (interpreter + compile), so the gate catches STALLED co-resident
+        # buckets, not steady-state latency drift
+        p99_factor = (
+            chaos_p99 / base_p99
+            if base_p99 and chaos_p99 is not None
+            else None
+        )
+        coresident_ok = bool(
+            coresident_isolated
+            and p99_factor is not None
+            and p99_factor <= 10.0
+        )
+
+        completed_steps = sum(r["result"]["steps"] for r in chaos_recs)
+        iso_max = max(iso_diffs) if iso_diffs else None
+        solo_ok = iso_max is not None and iso_max <= 1e-9
+        gang_reclaimed = bool(
+            chaos_r["gang_member_lost"] >= 1 and chaos_r["restored_sched"] >= 1
+        )
+        lost_ok = zero_lost(base_r) and zero_lost(chaos_r)
+        return {
+            # headline rate: fleet-mechanics leg — completed member-steps
+            # over the chaos pair's wall (kill + reclaim + finish)
+            "steps_per_sec": completed_steps / max(chaos_wall, 1e-9),
+            "unit_note": (
+                "steps_per_sec = member-steps/s across the gang-kill "
+                "chaos pair (2-proc CPU sub-mesh harness; mechanics, "
+                "not throughput)"
+            ),
+            "requests_gang": n_gang,
+            "requests_vmapped": n_vmap,
+            "baseline": {
+                "wall_s": round(base_wall, 1),
+                "gang_formed": base_r["gang_formed"],
+                "submesh_rejected": base_r["submesh_rejected"],
+                "vmapped_p99_s": base_p99,
+            },
+            "chaos": {
+                "wall_s": round(chaos_wall, 1),
+                "gang_formed": chaos_r["gang_formed"],
+                "gang_member_lost": chaos_r["gang_member_lost"],
+                "requeued": chaos_r["requeued"],
+                "restored_mid_trajectory": chaos_r["restored_sched"],
+                "vmapped_p99_s": chaos_p99,
+            },
+            "coresident_p99_factor": p99_factor,
+            "solo_rel_err_max": iso_max,
+            "zero_lost": lost_ok,
+            "gang_killed": gang_killed,
+            "gang_reclaimed": gang_reclaimed,
+            "solo_ok": solo_ok,
+            "coresident_ok": coresident_ok,
+            "finite": bool(
+                lost_ok
+                and gang_killed
+                and gang_reclaimed
+                and solo_ok
+                and coresident_ok
+            ),
+        }
+    finally:
+        shutil.rmtree(base_dir, ignore_errors=True)
+        shutil.rmtree(chaos_dir, ignore_errors=True)
+
+
 def bench_serve(nx=129, ny=129, ra=1e7, dt=2e-3, steps_per_req=8):
     """serve129: the simulation-service soak (rustpde_mpi_tpu/serve/).
 
@@ -2288,6 +2512,10 @@ def main() -> int:
                 # autoscaled fleet under Poisson preemptions (ISSUE 17):
                 # controller + launcher chaos leg, fleet mechanics gates
                 r = bench_autoscale()
+            elif name == "serve_submesh129":
+                # gang-scheduled sub-mesh serving (PR 18): mixed sharded +
+                # vmapped traffic, gang-kill chaos pair vs clean baseline
+                r = bench_serve_submesh()
             elif name == "workloads129":
                 # multi-model campaign rates (dns/lnse/adjoint) + the
                 # parity and onset-sign gates

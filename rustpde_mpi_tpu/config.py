@@ -147,7 +147,12 @@ register_knob("RUSTPDE_SYNC_TIMEOUT_S", "0",
               "barrier/broadcast watchdog (0 = off): peer death -> DispatchHang")
 register_knob("RUSTPDE_IO_TIMEOUT_S", None, "async checkpoint writer watchdog")
 register_knob("RUSTPDE_FAULT", None,
-              "fault injection <nan|spike|kill|slow>@<step>[:host<p>]")
+              "fault injection <nan|spike|kill|slow>@<step>"
+              "[:host<p>|:gang<g>[member<m>]]")
+register_knob("RUSTPDE_GANG_SYNC_TIMEOUT_S", "0",
+              "gang-barrier watchdog (0 = off): a dead gang member trips "
+              "this deadline and surfaces as typed GangMemberLost instead "
+              "of a wedged collective")
 register_knob("RUSTPDE_SHARD_CRASH", None,
               "two-phase commit window kill <after_shard|before_manifest>@<step>[:host<p>]")
 register_knob("RUSTPDE_SPIKE_FACTOR", None, "spike fault velocity scale override")
@@ -201,6 +206,9 @@ register_knob("RUSTPDE_FLEET_BENCH_REQUESTS", "10",
 register_knob("RUSTPDE_AUTOSCALE_BENCH_REQUESTS", "6",
               "autoscale129 chaos leg request count (autoscaled fleet under "
               "Poisson preemptions)", "bench")
+register_knob("RUSTPDE_GANG_BENCH_REQUESTS", "2",
+              "serve_submesh129 gang-sharded request count (the co-resident "
+              "vmapped count rides along, min 2)", "bench")
 # test harness (tests/ — raw reads allowed, names registered)
 register_knob("RUSTPDE_SLOW", None, "1 = run the slow test tier", "test")
 register_knob("RUSTPDE_TEST_BUDGET_S", "45", "per-test wall budget (fast tier)", "test")
@@ -212,6 +220,10 @@ register_knob("RUSTPDE_MP_SERVE_REQUESTS", "5",
               "mp_worker serve_campaign request count", "test")
 register_knob("RUSTPDE_MP_SERVE_SLOTS", "2",
               "mp_worker serve_campaign slot count", "test")
+register_knob("RUSTPDE_MP_GANG_REQUESTS", "2",
+              "mp_worker gang_serve sharded (gang-scheduled) request count", "test")
+register_knob("RUSTPDE_MP_VMAP_REQUESTS", "3",
+              "mp_worker gang_serve vmapped co-resident request count", "test")
 register_knob("RUSTPDE_SERVE_SOAK_REQUESTS", None,
               "serve chaos soak request count", "test")
 
@@ -569,7 +581,14 @@ class AutoscaleConfig:
 
     ``notice_s`` seeds ``RUSTPDE_PREEMPT_NOTICE_S`` in launched replicas
     (None: inherit the environment): preemptible capacity should drain
-    urgently when its platform says the clock is running."""
+    urgently when its platform says the clock is running.
+
+    ``gang_size`` makes capacity GANG-SHAPED (two-level serving): every
+    scale decision moves ``gang_size`` replicas as one fate-shared unit —
+    spawns go through the launcher's all-or-nothing ``spawn_gang`` and
+    scale-in retires a whole gang or nothing, so the fleet never holds a
+    lone gang member that could wedge a sharded campaign's collectives.
+    The default 1 is exactly the pre-gang control law."""
 
     min_replicas: int = 1
     max_replicas: int = 4
@@ -582,6 +601,37 @@ class AutoscaleConfig:
     spawn_grace_s: float = 60.0
     notice_s: float | None = None
     replica_prefix: str = "auto"
+    gang_size: int = 1
+
+
+@dataclass
+class SubmeshConfig:
+    """Two-level serving (parallel/submesh.py + serve/fleet/gang.py): the
+    device fleet is carved into SUB-MESHES so one pencil-sharded flagship
+    bucket runs as a gang on a slice while vmapped small-grid buckets
+    keep the remainder — with the gang as the failure domain.
+
+    * ``shapes`` — sub-mesh sizes (device counts) to carve, e.g.
+      ``(2,)`` on the 2-proc CPU harness or ``(8, 4)`` on a pod slice.
+      Shapes the current fleet cannot hold are dropped from the carve
+      (the elastic re-planner re-maps stamped buckets, journaled
+      ``gang_replanned``); on a multi-process runtime a shape must be a
+      multiple of the process count so every process participates in
+      every sub-mesh's collectives,
+    * ``shard_min_nx`` — grids at/above this extent are SHARDED traffic:
+      admission stamps them with the smallest fitting configured shape
+      (the stamp joins the compat key, so equal grids bucket together);
+      below it requests stay vmapped default traffic with today's keys,
+    * ``max_pending`` — admission bound on QUEUED sharded requests per
+      stamped shape: past it the POST gets a 429 ``reason="capacity"``
+      with queue-depth-derived Retry-After (a fitting sub-mesh exists
+      but is busy); a grid that fits NO configured shape is a typed 400
+      ``reason="no_submesh"`` at POST time — never a durable poison
+      pill."""
+
+    shapes: tuple = (2,)
+    shard_min_nx: int = 257
+    max_pending: int = 32
 
 
 @dataclass
@@ -681,6 +731,12 @@ class ServeConfig:
     # ReplicaLauncher — never a collective.  The controller can equally
     # run standalone (examples/navier_rbc_autoscale.py).
     autoscale: AutoscaleConfig | None = None
+    # two-level serving (None = off, the default: byte-identical serve
+    # behavior — 10-tuple compat keys everywhere, zero gang journal rows,
+    # CI-asserted): carve the device fleet into sub-meshes and serve
+    # pencil-sharded flagship buckets as fate-shared GANGS on slices
+    # while vmapped buckets keep the remainder.  See SubmeshConfig.
+    submesh: SubmeshConfig | None = None
 
 
 @dataclass

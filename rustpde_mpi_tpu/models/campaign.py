@@ -838,6 +838,23 @@ class CampaignModelBase:
         device dispatch, cached per state, fetched in ONE host transfer."""
         return self.get_observables_async().result()
 
+    def device_fence(self) -> None:
+        """Block until every dispatched device computation whose output this
+        model still holds has completed: the state chunk, the running stats
+        sums, and the cached observables dispatch.  The serve scheduler runs
+        this before any host-level collective while the campaign occupies a
+        PROPER sub-mesh — a full-device barrier would otherwise start on the
+        sub-mesh's idle complement and its wire traffic interleaves with the
+        campaign's in-flight collectives (multihost.set_device_fence)."""
+        if self.state is not None:
+            jax.block_until_ready(self.state)
+        stats = getattr(self, "stats_state", None)
+        if stats is not None:
+            jax.block_until_ready(stats)
+        cache = self._obs_cache
+        if cache is not None and not cache[1].ready():
+            cache[1].result()
+
     def div_norm(self) -> float:
         """The NaN-detector observable (index 3 by convention)."""
         return self.get_observables()[3]

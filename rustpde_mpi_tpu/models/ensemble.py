@@ -993,6 +993,22 @@ class NavierEnsemble(Integrate):
         :meth:`alive` / ``mask``, not ``isfinite(nu)``."""
         return self.get_observables_async().result()
 
+    def device_fence(self) -> None:
+        """Block until every dispatched device computation whose output this
+        ensemble still holds has completed: the vmapped state chunk, the
+        stats sums, and the cached observables dispatch.  Same contract as
+        the sharded campaign's fence — the serve scheduler runs it before
+        host-level collectives while the ensemble occupies a proper
+        sub-mesh (multihost.set_device_fence)."""
+        if self.state is not None:
+            jax.block_until_ready(self.state)
+        stats = getattr(self, "stats_state", None)
+        if stats is not None:
+            jax.block_until_ready(stats)
+        cache = self._obs_cache
+        if cache is not None and not cache[1].ready():
+            cache[1].result()
+
     def eval_nu(self) -> np.ndarray:
         return self.get_observables()[0]
 

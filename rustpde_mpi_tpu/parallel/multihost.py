@@ -63,6 +63,32 @@ def _cluster_env_configured() -> bool:
     return False
 
 
+#: pre-collective device fence (set_device_fence): while a campaign runs on
+#: a PROPER sub-mesh, host-level collectives here (full-device barriers and
+#: broadcasts) can start on the sub-mesh's IDLE complement immediately and
+#: their wire traffic interleaves nondeterministically with the campaign's
+#: still-in-flight collectives on the same transport pairs — gloo then
+#: mispairs ops across hosts ("op.preamble.length <= op.nbytes").  A full
+#: mesh never hits this: the barrier executable cannot start anywhere until
+#: the step program releases the devices, so wire order is host-consistent.
+#: The serve scheduler installs a fence that blocks on the active campaign's
+#: dispatches; every entry point below runs it before touching the wire.
+_device_fence = None
+
+
+def set_device_fence(fn) -> None:
+    """Install (``fn``) or clear (``None``) the pre-collective device fence —
+    the serve scheduler's sub-mesh campaign guard (see ``_device_fence``)."""
+    global _device_fence
+    _device_fence = fn
+
+
+def _fence() -> None:
+    fence = _device_fence
+    if fence is not None:
+        fence()
+
+
 def initialize_distributed(
     coordinator_address: str | None = None,
     num_processes: int | None = None,
@@ -180,6 +206,7 @@ def allgather_host(value) -> np.ndarray:
     _sanitizer.record("allgather", payload=value)
     if jax.process_count() == 1:
         return np.asarray(value)[None]  # lint-ok: RPD005 allgather payloads are small host values by contract
+    _fence()
     from jax.experimental import multihost_utils
 
     out = np.asarray(multihost_utils.process_allgather(np.asarray(value)))  # lint-ok: RPD005 allgather payloads are small host values by contract
@@ -206,6 +233,7 @@ def broadcast(value, is_source: bool | None = None):
     _sanitizer.record("broadcast", payload=value)
     if jax.process_count() == 1:
         return np.asarray(value)  # lint-ok: RPD005 broadcast payloads are small host values by contract
+    _fence()
     from jax.experimental import multihost_utils
 
     def run():
@@ -298,7 +326,7 @@ def tuplify(obj):
     return obj
 
 
-def sync_hosts(tag: str = "barrier") -> None:
+def sync_hosts(tag: str = "barrier", timeout_s: float | None = None) -> None:
     """Cross-host barrier (the reference's MPI barrier,
     src/field_mpi/io_mpi_sequ.rs:46); no-op single-host.
 
@@ -307,13 +335,22 @@ def sync_hosts(tag: str = "barrier") -> None:
     (default off) arms a watchdog: after the deadline every thread's stack is
     dumped to stderr together with the barrier tag, and a structured
     :class:`~rustpde_mpi_tpu.utils.resilience.DispatchHang` is raised so the
-    scheduler sees a crash it can restart instead of a wedged job."""
+    scheduler sees a crash it can restart instead of a wedged job.
+
+    ``timeout_s`` overrides the env knob for callers with a tighter
+    deadline contract than the job-wide default — the gang barrier
+    (serve/fleet/gang.py) passes ``RUSTPDE_GANG_SYNC_TIMEOUT_S`` here so
+    a dead gang member surfaces in seconds, not the global sync budget."""
     _sanitizer.record("sync", tag=tag)
     if jax.process_count() == 1:
         return
+    _fence()
     from jax.experimental import multihost_utils
 
-    timeout = float(env_get("RUSTPDE_SYNC_TIMEOUT_S", "0") or 0.0)
+    if timeout_s is not None:
+        timeout = float(timeout_s)
+    else:
+        timeout = float(env_get("RUSTPDE_SYNC_TIMEOUT_S", "0") or 0.0)
     if timeout <= 0:
         multihost_utils.sync_global_devices(tag)
     else:

@@ -143,11 +143,12 @@ class Autoscaler:
             and (now - self._last_action_mono) < self.cfg.cooldown_s
         )
 
-    def _pick_victim(self, obs: dict):
-        """Scale-in victim: a launcher-owned, heartbeat-fresh, not-yet-
-        retired replica with the fewest occupied slots (the cheapest
-        drain).  None when the launcher owns nothing retirable — the
-        controller never signals replicas it did not launch."""
+    def _pick_victims(self, obs: dict, n: int) -> list:
+        """Scale-in victims: launcher-owned, heartbeat-fresh, not-yet-
+        retired replicas with the fewest occupied slots (the cheapest
+        drains).  Returns exactly ``n`` handles or ``[]`` — gang-shaped
+        capacity (``gang_size > 1``) retires a whole gang or nothing,
+        and the controller never signals replicas it did not launch."""
         victims = []
         for h in getattr(self.launcher, "handles", list)():
             if h.retired or not self.launcher.alive(h):
@@ -157,16 +158,19 @@ class Autoscaler:
                 continue
             occupied = (rec.get("slots") or [0])[0]
             victims.append((occupied, h.replica_id, h))
-        if not victims:
-            return None
+        if len(victims) < n:
+            return []
         victims.sort(key=lambda v: (v[0], v[1]))
-        return victims[0][2]
+        return [v[2] for v in victims[:n]]
 
     def decide(self, obs: dict) -> dict:
         """Apply the control law to one observation.  Returns the typed
         decision record (the ``autoscale_decision`` journal row body);
         ``action`` is ``scale_out`` / ``scale_in`` / ``hold``."""
         cfg = self.cfg
+        # gang-shaped capacity: every scale action moves `unit` replicas
+        # as one fate-shared group (1 = the pre-gang control law)
+        unit = max(1, int(getattr(cfg, "gang_size", 1)))
         now = self._mono()
         capacity = obs["alive"] + obs["pending"]
         busy = obs["queued"] > 0 or obs["running"] > 0
@@ -184,15 +188,18 @@ class Autoscaler:
         else:
             self._idle_since = None
 
-        action, reason, victim = "hold", "steady", None
+        action, reason, victims = "hold", "steady", []
         if capacity < cfg.min_replicas:
             # capacity repair (a preempted replica died): immediate and
             # cooldown-exempt — replacement is not elective growth
             action, reason = "scale_out", "below_min"
         elif capacity > cfg.max_replicas:
             action, reason = "scale_in", "above_max"
-            victim = self._pick_victim(obs)
-        elif obs["min_slack_s"] < cfg.slack_low_s and capacity < cfg.max_replicas:
+            victims = self._pick_victims(obs, unit)
+        elif (
+            obs["min_slack_s"] < cfg.slack_low_s
+            and capacity + unit <= cfg.max_replicas
+        ):
             if self._in_cooldown(now):
                 action, reason = "hold", "cooldown"
             else:
@@ -201,7 +208,7 @@ class Autoscaler:
             self._high_since is not None
             and (now - self._high_since) >= cfg.sustain_s
         ):
-            if capacity >= cfg.max_replicas:
+            if capacity + unit > cfg.max_replicas:
                 action, reason = "hold", "at_max"
             elif self._in_cooldown(now):
                 action, reason = "hold", "cooldown"
@@ -210,25 +217,30 @@ class Autoscaler:
         elif (
             self._idle_since is not None
             and (now - self._idle_since) >= cfg.idle_sustain_s
-            and capacity > cfg.min_replicas
+            and capacity - unit >= cfg.min_replicas
         ):
             if self._in_cooldown(now):
                 action, reason = "hold", "cooldown"
             else:
                 action, reason = "scale_in", "idle"
-                victim = self._pick_victim(obs)
-                if victim is None:
+                victims = self._pick_victims(obs, unit)
+                if not victims:
                     action, reason = "hold", "no_owned_victim"
         elif self._high_since is not None:
             action, reason = "hold", "pressure_building"
-        elif self._idle_since is not None and capacity > cfg.min_replicas:
+        elif (
+            self._idle_since is not None
+            and capacity - unit >= cfg.min_replicas
+        ):
             action, reason = "hold", "idle_building"
 
         desired = capacity
         if action == "scale_out":
-            desired = min(capacity + 1, max(cfg.max_replicas, cfg.min_replicas))
+            desired = min(
+                capacity + unit, max(cfg.max_replicas, cfg.min_replicas)
+            )
         elif action == "scale_in":
-            desired = max(capacity - 1, cfg.min_replicas)
+            desired = max(capacity - unit, cfg.min_replicas)
         return {
             "action": action,
             "reason": reason,
@@ -242,8 +254,9 @@ class Autoscaler:
                 if obs["min_slack_s"] == float("inf")
                 else round(obs["min_slack_s"], 3)
             ),
-            "victim": victim.replica_id if victim is not None else None,
-            "_victim_handle": victim,
+            "victim": victims[0].replica_id if victims else None,
+            "victims": [h.replica_id for h in victims],
+            "_victim_handles": victims,
         }
 
     # -- act -------------------------------------------------------------------
@@ -267,45 +280,61 @@ class Autoscaler:
 
     def act(self, decision: dict) -> None:
         cfg = self.cfg
+        unit = max(1, int(getattr(cfg, "gang_size", 1)))
         if decision["action"] == "scale_out":
-            self._seq += 1
-            rid = f"{cfg.replica_prefix}-{os.getpid()}-{self._seq}"
-            handle = self.launcher.spawn(rid)
-            self.spawned += 1
+            rids = []
+            for _ in range(unit):
+                self._seq += 1
+                rids.append(f"{cfg.replica_prefix}-{os.getpid()}-{self._seq}")
+            if unit > 1:
+                # all-or-nothing: a spawn failure rolls the partial gang
+                # back inside the launcher and re-raises
+                handles = self.launcher.spawn_gang(rids)
+            else:
+                handles = [self.launcher.spawn(rids[0])]
+            self.spawned += len(handles)
             self.decisions += 1
             if decision["reason"] != "below_min":
                 self._last_action_mono = self._mono()
-            self._journal(
-                {
+            for handle in handles:
+                row = {
                     "event": "replica_spawned",
-                    "replica": rid,
+                    "replica": handle.replica_id,
                     "pid": handle.pid,
                     "reason": decision["reason"],
                 }
-            )
-            self.registry.counter(
-                "autoscale_spawned_total", "replicas spawned by the autoscaler"
-            ).inc()
+                if unit > 1:
+                    row["gang"] = rids
+                self._journal(row)
+                self.registry.counter(
+                    "autoscale_spawned_total",
+                    "replicas spawned by the autoscaler",
+                ).inc()
         elif decision["action"] == "scale_in":
-            handle = decision.get("_victim_handle")
-            if handle is None:
+            handles = decision.get("_victim_handles") or []
+            if not handles:
                 return
-            self.launcher.retire(handle)
-            self.retired += 1
+            if len(handles) > 1:
+                self.launcher.retire_gang(handles)
+            else:
+                self.launcher.retire(handles[0])
+            self.retired += len(handles)
             self.decisions += 1
             self._last_action_mono = self._mono()
-            self._journal(
-                {
+            for handle in handles:
+                row = {
                     "event": "replica_retired",
                     "replica": handle.replica_id,
                     "pid": handle.pid,
                     "reason": decision["reason"],
                 }
-            )
-            self.registry.counter(
-                "autoscale_retired_total",
-                "replicas retired (drained) by the autoscaler",
-            ).inc()
+                if len(handles) > 1:
+                    row["gang"] = [h.replica_id for h in handles]
+                self._journal(row)
+                self.registry.counter(
+                    "autoscale_retired_total",
+                    "replicas retired (drained) by the autoscaler",
+                ).inc()
 
     def step(self) -> dict:
         """One control evaluation: observe → decide → journal → act →

@@ -67,6 +67,37 @@ class ReplicaLauncher:
         """Collect exited replicas; return their handles."""
         raise NotImplementedError
 
+    # -- gang-shaped capacity (two-level serving) -------------------------------
+
+    def spawn_gang(self, replica_ids: list[str]) -> list[ReplicaHandle]:
+        """Spawn a fate-shared replica group ALL-OR-NOTHING: either every
+        id comes up or the partial gang is killed and the spawn failure
+        re-raised.  A lone gang member is worse than no gang — it claims
+        a member lease and then wedges the sub-mesh collective its
+        missing peers never join — so partial success is never returned
+        (the same rollback contract ``GangLease.form`` makes for
+        leases)."""
+        handles: list[ReplicaHandle] = []
+        try:
+            for rid in replica_ids:
+                handles.append(self.spawn(rid))
+        except Exception:
+            for handle in handles:
+                try:
+                    self.kill(handle)
+                except Exception:  # noqa: BLE001 — rollback is best effort
+                    pass
+            raise
+        return handles
+
+    def retire_gang(self, handles: list[ReplicaHandle]) -> None:
+        """Retire a whole gang together: every member gets the drain
+        signal in one pass, so the gang parks as a unit (sharded state
+        through the two-phase continuation writer) instead of one member
+        draining while its peers block on the next collective."""
+        for handle in handles:
+            self.retire(handle)
+
 
 class LocalProcessLauncher(ReplicaLauncher):
     """Local-subprocess backend: each replica is ``python -m

@@ -168,9 +168,11 @@ class FleetProxy:
         fleet=None,
         registry=None,
         auth_tokens: list[str] | None = None,
+        submesh=None,
     ):
         self.run_dir = run_dir
         self.fleet = fleet
+        self.submesh = submesh
         if auth_tokens is None:
             raw = env_get("RUSTPDE_PROXY_TOKENS") or ""
             auth_tokens = [t.strip() for t in raw.split(",") if t.strip()]
@@ -266,6 +268,37 @@ class FleetProxy:
             )
         req = SimRequest.from_dict(data)
         req.validate()
+        if self.submesh is not None:
+            # stamp sharded grids with their sub-mesh shape at the DOOR, so
+            # every proxy and the root front bucket the same grid the same
+            # way; permanent shape mismatches die here as typed 400s
+            # instead of poisoning the durable queue
+            self.queue.invalidate()
+            pending = sum(
+                1
+                for _, queued in self.queue.snapshot_queued()
+                if int(getattr(queued, "submesh", 0)) > 0
+            )
+            try:
+                req = _qos.admit_submesh(req, pending, self.submesh)
+            except (AdmissionError, ValueError) as exc:
+                reason = getattr(exc, "reason", None)
+                if reason not in ("no_submesh", "capacity"):
+                    raise
+                _tm.counter(
+                    "fleet_submesh_rejected_total",
+                    "submits rejected by sub-mesh admission",
+                    reason=reason,
+                ).inc()
+                self._journal(
+                    {
+                        "event": "submesh_rejected",
+                        "id": req.id,
+                        "reason": reason,
+                        "grid": [int(req.nx), int(req.ny)],
+                    }
+                )
+                raise
         if self.fleet is not None:
             # stale cache is fine for a QUOTA (it only over/under-counts
             # by the race window), but refresh so peer-proxy submits count
@@ -404,7 +437,11 @@ class FleetProxy:
                     )
                     return reply_json(self, 429, payload, headers)
                 except (RequestError, ValueError, TypeError) as exc:
-                    return reply_json(self, 400, {"error": str(exc)})
+                    payload = {"error": str(exc)}
+                    reason = getattr(exc, "reason", None)
+                    if reason:
+                        payload["reason"] = reason
+                    return reply_json(self, 400, payload)
                 return reply_json(
                     self,
                     202,

@@ -6,8 +6,14 @@ decisions are broadcast like every other scheduling verdict) and the
 stateless proxy calls them at admission; both journal the outcomes
 themselves.
 
-Three levers:
+Four levers:
 
+* **sub-mesh admission** — :func:`admit_submesh` stamps sharded-grid
+  requests with the sub-mesh shape they gang onto (two-level serving)
+  and converts the two mismatch shapes into typed rejects: permanently
+  unservable grids into ``reason="no_submesh"`` 400s, transient sharded
+  backlog into ``reason="capacity"`` 429s with a queue-depth-derived
+  ``Retry-After``,
 * **per-tenant quotas** — :func:`check_quota` bounds one tenant's
   queued+running footprint; past it the submit is rejected with the typed
   ``reason="quota"`` :class:`~rustpde_mpi_tpu.serve.AdmissionError`
@@ -29,9 +35,54 @@ Three levers:
 
 from __future__ import annotations
 
+import dataclasses
 import time
 
-from ..request import AdmissionError, SimRequest
+from ...parallel import submesh as _submesh
+from ..request import AdmissionError, RequestError, SimRequest
+
+
+def admit_submesh(
+    req: SimRequest, pending_sharded: int, cfg
+) -> SimRequest:
+    """Two-level-serving admission (parallel/submesh.py): stamp ``req``
+    with the sub-mesh device count its grid needs, or reject it typed.
+
+    ``cfg`` is the service's :class:`~rustpde_mpi_tpu.config.SubmeshConfig`
+    (None = feature off: the request passes through untouched, byte-
+    identical default).  Small grids stay unstamped (vmapped traffic).  A
+    grid at/above the sharding threshold that fits NO configured shape is
+    a permanent mismatch for this service — typed ``reason="no_submesh"``
+    :class:`RequestError` (HTTP 400) at POST time, not a durable poison
+    pill that wedges every later serve pass.  A grid that DOES fit but
+    finds the sharded backlog at ``max_pending`` is a transient capacity
+    reject — ``reason="capacity"`` :class:`AdmissionError` (HTTP 429)
+    whose ``Retry-After`` scales with the live sharded queue depth.
+    ``pending_sharded`` is the caller's census of queued stamped requests.
+    """
+    if cfg is None:
+        return req
+    shape = _submesh.shape_for(int(req.nx), int(req.ny), cfg)
+    if shape == 0:
+        return req
+    if shape < 0:
+        raise RequestError(
+            f"grid {req.nx}x{req.ny} needs sharding (>= {cfg.shard_min_nx}"
+            f" points) but fits none of the configured sub-mesh shapes "
+            f"{tuple(cfg.shapes)}",
+            reason="no_submesh",
+        )
+    pending = int(pending_sharded)
+    if pending >= int(cfg.max_pending):
+        raise AdmissionError(
+            "capacity",
+            f"{pending} sharded requests already queued "
+            f"(max_pending={cfg.max_pending}); retry once gangs drain",
+            retry_after_s=2.0 * max(1, pending),
+        )
+    if int(req.submesh) == shape:
+        return req
+    return dataclasses.replace(req, submesh=shape)
 
 
 def check_quota(req: SimRequest, tenant_counts: dict, fleet_cfg) -> None:

@@ -270,7 +270,14 @@ class HttpFront:
                     )
                     return self._reply(429, payload, headers)
                 except (RequestError, ValueError, TypeError) as exc:
-                    return self._reply(400, {"error": str(exc)})
+                    # typed malformed-request rejects (e.g. the sub-mesh
+                    # admission's "no_submesh") carry a machine-readable
+                    # reason alongside the human-readable message
+                    payload = {"error": str(exc)}
+                    reason = getattr(exc, "reason", None)
+                    if reason:
+                        payload["reason"] = reason
+                    return self._reply(400, payload)
                 return self._reply(
                     202,
                     {"id": req.id, "steps": req.steps, "trace_id": req.trace_id},

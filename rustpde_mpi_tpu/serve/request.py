@@ -31,7 +31,15 @@ import uuid
 
 class RequestError(ValueError):
     """A submitted request is malformed (bad grid/dt/horizon/bc): rejected
-    at admission, before it can poison a batch."""
+    at admission, before it can poison a batch.  ``reason`` optionally
+    names a machine-readable rejection class the HTTP fronts surface in
+    the 400 body (``"no_submesh"``: a sharded grid fits none of the
+    configured sub-mesh shapes — permanently unservable here, distinct
+    from the retryable 429 capacity reject)."""
+
+    def __init__(self, message: str, reason: str | None = None):
+        super().__init__(message)
+        self.reason = reason
 
 
 class AdmissionError(RuntimeError):
@@ -124,6 +132,13 @@ class SimRequest:
     deadline_s: float | None = None
     seed: int = 0
     amp: float | None = None  # IC amplitude (None: ServeConfig.default_amp)
+    # sub-mesh stamp (two-level serving, parallel/submesh.py): 0 = vmapped
+    # default traffic (compat_key stays the bare 10-tuple — byte-identical
+    # to a service without SubmeshConfig); >0 = the device count of the
+    # sub-mesh this sharded request is gang-scheduled onto, stamped at
+    # admission from the configured shapes so every front buckets equal
+    # grids identically.  Clients never set it; admission owns the stamp.
+    submesh: int = 0
     id: str = ""
     submitted_s: float = 0.0  # unix time at admission (latency accounting)
     enqueued_s: float = 0.0  # unix time of the FIRST durable enqueue
@@ -176,6 +191,10 @@ class SimRequest:
             raise RequestError(
                 f"deadline_s must be positive (or null), got {self.deadline_s}"
             )
+        if int(self.submesh) < 0:
+            raise RequestError(
+                f"submesh stamp must be >= 0, got {self.submesh}"
+            )
         from ..workloads.registry import model_kinds
 
         if self.model not in model_kinds():
@@ -212,7 +231,7 @@ class SimRequest:
         model kind first, canonical scenario signature last)."""
         from ..models.navier import scenario_signature
 
-        return (
+        key = (
             str(self.model),
             int(self.nx),
             int(self.ny),
@@ -224,6 +243,12 @@ class SimRequest:
             bool(self.periodic),
             scenario_signature(self.scenario),
         )
+        # gang traffic gains the sub-mesh stamp as an 11th element so
+        # sharded buckets never co-batch with vmapped ones; unstamped
+        # requests keep the bare 10-tuple (byte-identical default)
+        if int(self.submesh) > 0:
+            key = key + (int(self.submesh),)
+        return key
 
     @property
     def steps(self) -> int:

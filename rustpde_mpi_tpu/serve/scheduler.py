@@ -93,6 +93,7 @@ import numpy as np
 
 from ..config import IOConfig, ServeConfig, env_get
 from ..models.ensemble import NavierEnsemble
+from ..parallel import submesh as _sm
 from ..telemetry import compile_log as _cl
 from ..telemetry import metrics as _tm
 from ..telemetry import reqtrace as _rt
@@ -102,7 +103,8 @@ from ..utils import checkpoint
 from ..workloads.registry import build_model_for_key
 from ..utils.faults import FaultPlan, validate_fault_env
 from ..utils.journal import JournalWriter, read_journal
-from ..utils.resilience import ResilientRunner
+from ..utils.resilience import DispatchHang, ResilientRunner
+from .fleet.gang import GangMemberLost
 from .queue import DurableQueue
 from .request import AdmissionError, RequestFailed, SimRequest
 
@@ -133,6 +135,25 @@ class _ServedEnsemble(NavierEnsemble):
             meta = json.loads(bytes(np.asarray(root["serve_slots"])).decode("utf-8"))
             self.serve_meta = meta
             self.restored_meta = meta
+
+
+def _transport_death(exc: BaseException) -> bool:
+    """A collective-transport failure that means a PEER process died (gloo
+    connection reset, socket closed, coordination-service abort): the
+    survivors' view of a gang member's death when it strikes mid-dispatch
+    instead of at a gang barrier."""
+    msg = str(exc).lower()
+    return any(
+        marker in msg
+        for marker in (
+            "connection reset",
+            "connection refused",
+            "socket closed",
+            "gloo",
+            "coordination service",
+            "distributed service",
+        )
+    )
 
 
 @dataclasses.dataclass
@@ -248,6 +269,12 @@ class SimServer:
         self._failed = 0
         self._retried = 0
         self._pending_results: list[tuple] = []  # (obs_future, [(slot,req,..)])
+        # sub-mesh campaign fence (multihost.set_device_fence): the active
+        # campaign's ensemble plus every boundary dispatch whose future is
+        # still unfetched — blocked on before any host-level collective so
+        # full-device barriers cannot interleave with sub-mesh programs
+        self._fence_ens = None
+        self._inflight_futs: list = []
         self._prev_handlers: dict = {}
         self._http = None
         # live serve telemetry (telemetry/metrics.py): slot occupancy of the
@@ -272,6 +299,23 @@ class SimServer:
         self._parked: dict[str, tuple] = {}
         self._replans = 0
         self._dt_adjusts = 0  # proactive bucket_dt_adjust events
+        # two-level serving (cfg.submesh, parallel/submesh.py): the lazily
+        # carved device plan, the mesh cache per carved slice, the ACTIVE
+        # campaign's mesh + local-device share (telemetry), and the gang
+        # chapter of the running campaign — placement resolved at model
+        # build, lease group formed at open, fault scope bound for the
+        # campaign's duration.  submesh=None leaves ALL of it inert: no
+        # plan is carved, no gang row is journaled (CI-asserted).
+        self._submesh = self.cfg.submesh
+        self._submesh_plan: _sm.SubmeshPlan | None = None
+        self._submesh_meshes: dict[int, object] = {}
+        self._active_mesh = None
+        self._active_share: tuple[int, int] | None = None
+        self._gang_placement: tuple | None = None  # (Submesh, replanned)
+        self._gang_active: dict | None = None
+        self._gang_lease = None  # fate-shared lease group (root, fleet)
+        self._gangs_formed = 0
+        self._gang_members_lost = 0
 
     # -- multihost coordination ----------------------------------------------
 
@@ -320,6 +364,53 @@ class SimServer:
 
         multihost.sync_hosts(tag)
 
+    def _device_fence(self) -> None:
+        """Block until the active campaign's device dispatches complete —
+        installed via :func:`~rustpde_mpi_tpu.parallel.multihost
+        .set_device_fence` while a campaign occupies a PROPER sub-mesh.
+        Host-level collectives run over EVERY device, so on a sub-mesh
+        campaign their executables start immediately on the idle complement
+        and the wire traffic interleaves nondeterministically with the
+        campaign's in-flight collectives on the same transport pairs (gloo
+        aborts with a size-mismatched op).  A full-mesh campaign never
+        needs this — the barrier cannot start until the step program
+        releases the devices — which is why the fence is armed only when
+        ``cfg.submesh`` carves the fleet."""
+        ens = self._fence_ens
+        if ens is not None:
+            ens.device_fence()
+        futs, self._inflight_futs = self._inflight_futs, []
+        for fut in futs:
+            fut.result()
+
+    def _arm_device_fence(self, ens) -> None:
+        """Arm (or re-point, on a fleet swap/replan) the sub-mesh fence."""
+        if self._submesh is None or self._nproc() == 1:
+            return
+        from ..parallel import multihost
+
+        self._fence_ens = ens
+        multihost.set_device_fence(self._device_fence)
+
+    def _disarm_device_fence(self, drain: bool = True) -> None:
+        """Remove the fence at campaign teardown.  ``drain=False`` on the
+        gang-loss path: in-flight sub-mesh programs can never complete (a
+        peer is dead), so containment must not block on them.  Every other
+        exit drains first, so the campaign-close barrier and the next
+        campaign's collectives start with an idle wire."""
+        if self._fence_ens is None:
+            return
+        from ..parallel import multihost
+
+        multihost.set_device_fence(None)
+        if drain:
+            try:
+                self._device_fence()
+            except Exception:
+                pass  # poisoned buffers on an exceptional exit: disarm anyway
+        self._fence_ens = None
+        self._inflight_futs = []
+
     # -- client surface -------------------------------------------------------
 
     def submit(self, req: SimRequest | dict) -> SimRequest:
@@ -336,6 +427,41 @@ class SimServer:
             )
         if req.amp is None:
             req.amp = float(self.cfg.default_amp)
+        if self._submesh is not None:
+            # two-level serving admission: stamp the sub-mesh shape the
+            # grid needs (compat_key gains the stamp, so sharded buckets
+            # never co-batch with vmapped ones) or reject TYPED — a grid
+            # no configured shape fits is a 400 at POST time, never a
+            # durable poison pill; a full sharded backlog is a 429 whose
+            # Retry-After scales with the live queue depth
+            from .fleet import qos as _qos
+
+            try:
+                self.queue.invalidate()
+                pending = sum(
+                    1
+                    for _, r in self.queue.snapshot_queued()
+                    if int(getattr(r, "submesh", 0)) > 0
+                )
+                req = _qos.admit_submesh(req, pending, self._submesh)
+            except (AdmissionError, ValueError) as exc:
+                reason = getattr(exc, "reason", None)
+                if reason not in ("no_submesh", "capacity"):
+                    raise
+                _tm.counter(
+                    "serve_admission_rejected_total",
+                    "submits rejected by admission control",
+                    reason=reason,
+                ).inc()
+                self._journal(
+                    {
+                        "event": "submesh_rejected",
+                        "id": req.id,
+                        "reason": reason,
+                        "grid": [int(req.nx), int(req.ny)],
+                    }
+                )
+                raise
         if self._fleet is not None:
             # the QoS quota half of the traffic contract: one tenant's
             # burst degrades into typed 429s before it can starve peers
@@ -471,18 +597,114 @@ class SimServer:
         )
         return info
 
-    def _campaign_mesh(self):
-        """The mesh campaign models are built on: the global pencil mesh on
-        a multi-process runtime (the scheduler's collective dispatches must
-        span every host's devices), None single-controller (the existing
-        single-process behavior, unchanged)."""
-        if self._nproc() == 1:
-            return None
-        if not hasattr(self, "_mesh_cache"):
-            from ..parallel import multihost
+    def _campaign_mesh(self, key: tuple | None = None):
+        """The mesh campaign models are built on.
 
-            self._mesh_cache = multihost.global_pencil_mesh()
-        return self._mesh_cache
+        Single-level serving (``cfg.submesh=None``, the default): the
+        global pencil mesh on a multi-process runtime (the scheduler's
+        collective dispatches must span every host's devices), None
+        single-controller — byte-identical to the pre-sub-mesh behavior.
+
+        Two-level serving: the bucket ``key`` resolves through the carved
+        :class:`~rustpde_mpi_tpu.parallel.submesh.SubmeshPlan` — a stamped
+        (gang) bucket is PLACED onto its carved sub-mesh (elastically
+        re-mapped when the fleet shrank under the stamp, recorded for the
+        ``gang_replanned`` journal row), vmapped default traffic rides the
+        remainder slice when its grid divides onto it.  ``key=None`` (the
+        ``/healthz`` probe between builds) reports the ACTIVE campaign's
+        mesh."""
+        if self._submesh is None:
+            if self._nproc() == 1:
+                return None
+            if not hasattr(self, "_mesh_cache"):
+                from ..parallel import multihost
+
+                self._mesh_cache = multihost.global_pencil_mesh()
+            return self._mesh_cache
+        if key is None:
+            return self._active_mesh
+        plan = self._carve_plan()
+        shape = _sm.key_shape(key)
+        self._gang_placement = None
+        self._active_share = None
+        if shape > 0:
+            sub, replanned = plan.place(int(key[1]), int(key[2]), shape)
+            if sub is None:
+                # fleet too small for ANY carved slice: the default
+                # remainder (or solo) serves it unsharded — the request
+                # still resolves, only the sharding is waived
+                sub, replanned = plan.default, plan.default is not None
+            self._gang_placement = (sub, bool(replanned))
+            self._active_mesh = self._submesh_mesh(sub)
+            return self._active_mesh
+        sub = plan.default
+        if (
+            sub is not None
+            and self._nproc() > 1
+            and _sm.grid_fits(int(key[1]), int(key[2]), len(sub.devices))
+        ):
+            self._active_mesh = self._submesh_mesh(sub)
+        elif self._nproc() > 1:
+            # the vmapped grid divides no carved remainder: fall back to
+            # the whole-fleet pencil mesh (servability beats isolation
+            # for unstamped traffic)
+            if not hasattr(self, "_mesh_cache"):
+                from ..parallel import multihost
+
+                self._mesh_cache = multihost.global_pencil_mesh()
+            self._active_mesh = self._mesh_cache
+        else:
+            self._active_mesh = None  # single-controller vmapped path
+        return self._active_mesh
+
+    def _carve_plan(self) -> _sm.SubmeshPlan:
+        """The carved device plan, built once per incarnation.  Every
+        process derives the IDENTICAL plan from the globally-consistent
+        ``jax.devices()`` order — no broadcast needed — and a restart
+        after a fleet resize re-carves automatically (the elastic
+        re-planner: stamped buckets re-place through ``plan.place``)."""
+        if self._submesh_plan is None:
+            try:
+                import jax
+
+                devices = jax.devices()
+            except Exception:
+                devices = []
+            self._submesh_plan = _sm.carve(
+                devices, self._submesh.shapes, nproc=self._nproc()
+            )
+        return self._submesh_plan
+
+    def _submesh_mesh(self, sub):
+        """The (cached) jax Mesh over one carved slice; None for an empty
+        slice or a single-device slice on a single-controller runtime
+        (the plain vmapped path needs no mesh)."""
+        if sub is None or not sub.devices:
+            return None
+        if self._nproc() == 1 and len(sub.devices) <= 1:
+            return None
+        if sub.index not in self._submesh_meshes:
+            self._submesh_meshes[sub.index] = sub.mesh()
+        self._active_share = self._local_share(sub)
+        return self._submesh_meshes[sub.index]
+
+    def _local_share(self, sub) -> tuple[int, int] | None:
+        """(this host's devices inside ``sub``, this host's total local
+        devices) — the fleet-utilization gauges report the sub-mesh's
+        share of the fleet, not all-or-nothing."""
+        try:
+            import jax
+
+            pidx = int(jax.process_index())
+            total = int(jax.local_device_count())
+        except Exception:
+            return None
+        mine = sum(
+            1
+            for d in (sub.devices if sub is not None else ())
+            if int(getattr(d, "process_index", 0)) == pidx
+        )
+        return (mine, total)
 
     def stats(self) -> dict:
         out = {
@@ -497,6 +719,11 @@ class SimServer:
             "draining": self._drain,
             "slots": self.slot_info(),
         }
+        if self._submesh is not None:
+            out["gangs"] = {
+                "formed": self._gangs_formed,
+                "members_lost": self._gang_members_lost,
+            }
         if self._fleet is not None:
             out["fleet"] = {
                 "replica": self._replica_id,
@@ -766,6 +993,22 @@ class SimServer:
 
         self._fleet_heartbeat()
         self.queue.invalidate()  # proxies + peer replicas write behind us
+        if self._submesh is not None:
+            from .fleet import gang as _gang
+
+            # fate-shared gang sweep FIRST: a stale gang breaks group-
+            # then-members as a unit, so no member lease of a dead gang
+            # ever looks live on its own.  The bucket lease underneath is
+            # swept by the ordinary pass below, which re-enqueues the
+            # bucket's requests.
+            for rec in _gang.stale_gangs(self._lease_mgr):
+                self._journal(
+                    {
+                        "event": "gang_swept",
+                        "bucket": rec.get("bucket"),
+                        "owner": rec.get("owner"),
+                    }
+                )
         for rec in self._lease_mgr.sweep():
             # the dead holder's claims come back: queued again, scoped to
             # exactly the broken bucket — live peers' claims are untouched
@@ -775,6 +1018,8 @@ class SimServer:
                 "stale peer leases broken by this replica",
             ).inc()
             key = rec.get("bucket")
+            if key and key[0] in ("gang", "gang-member"):
+                continue  # gang bookkeeping: fate-shared by the gang sweep
             if key:
                 key = multihost.tuplify(key)
                 ids = self.queue.recover_bucket(key)
@@ -832,20 +1077,39 @@ class SimServer:
             pass  # heartbeat loss degrades to lease staleness, not a crash
         with self._hb_lock:
             lease = self._lease
-            if lease is None:
-                return
-            try:
-                lease.renew()
-            except LeaseLost as exc:
-                self._journal(
-                    {
-                        "event": "lease_fenced",
-                        "bucket": lease.tag,
-                        "detail": str(exc),
-                    }
-                )
-                self._lease = None
-                self._fenced = True
+            if lease is not None:
+                try:
+                    lease.renew()
+                except LeaseLost as exc:
+                    self._journal(
+                        {
+                            "event": "lease_fenced",
+                            "bucket": lease.tag,
+                            "detail": str(exc),
+                        }
+                    )
+                    self._lease = None
+                    self._fenced = True
+            # the gang lease group renews on the same heartbeat: group
+            # lease first, then every member's fencing token (gang.py) —
+            # losing ANY of them fences this replica exactly like losing
+            # the bucket lease (the campaign is abandoned at the next
+            # boundary, no further queue write)
+            glease = self._gang_lease
+            if glease is not None:
+                try:
+                    glease.renew()
+                except LeaseLost as exc:
+                    self._journal(
+                        {
+                            "event": "lease_fenced",
+                            "bucket": glease.tag,
+                            "gang": True,
+                            "detail": str(exc),
+                        }
+                    )
+                    self._gang_lease = None
+                    self._fenced = True
 
     def _start_heartbeat_thread(self) -> None:
         """Root-only, fleet-only: renew the lease + replica heartbeat on
@@ -948,7 +1212,7 @@ class SimServer:
         # per-compat-key compile attribution (telemetry/compile_log.py);
         # the journal row here is the durable copy of that observation.
         t_build = time.perf_counter()
-        model = build_model_for_key(key, mesh=self._campaign_mesh())
+        model = build_model_for_key(key, mesh=self._campaign_mesh(key))
         model.write_intervall = float("inf")  # no flow-file callback IO
         if self.cfg.stability is not None:
             # governed campaigns: arm the on-device sentinels BEFORE the
@@ -1058,12 +1322,22 @@ class SimServer:
         ck_k = self._peek_checkpoint_members(self._campaign_dir(key))
         runner, ens = self._build_runner(key, k=ck_k)
         self._runner = runner
+        self._arm_device_fence(ens)
         self._last_bucket = key  # round-robin cursor
         self._campaign_claims = 0  # fairness quantum consumption
         self._claims_closed = False  # re-opened per campaign
+        if not self._open_gang(key):
+            # gang formation lost its race (a stale generation still holds
+            # the group lease until the sweep breaks it): hand the bucket
+            # back and let a later pass retry — no campaign may run
+            # half-gang.  Every host took this branch together (the
+            # verdict is broadcast), so skipping the fences is aligned.
+            self._release_bucket_lease()
+            return
         if self._drain:  # a signal raced the build
             runner.request_drain()
-        self._sync("serve-campaign-open")
+        self._gang_fence("serve-campaign-open")
+        slots: list[_Slot] = []
         try:
             with runner.session(install_signals=False, resume=False):
                 self._try_resume(runner)
@@ -1092,6 +1366,36 @@ class SimServer:
                 self._fill_slots(runner, ens, slots, key)
                 self._refresh_slot_state(slots, ens.k)
                 self._campaign_loop(runner, ens, slots, key)
+        except (GangMemberLost, DispatchHang) as exc:
+            # gang fate-sharing: a dead member turned a barrier (typed
+            # GangMemberLost from the gang watchdog) or a chunk dispatch
+            # (DispatchHang) into a structured failure.  Containment is
+            # HOST-LOCAL — the peer is gone, so no collective may run —
+            # and only breaks THIS gang's lease: co-resident buckets'
+            # requests requeue with their durable parked state and the
+            # next incarnation reclaims them immediately, no TTL wait.
+            # Non-gang campaigns keep the existing structured-exit path.
+            self._disarm_device_fence(drain=False)
+            if self._gang_active is not None:
+                self._contain_gang_loss(key, slots, exc)
+            raise
+        except Exception as exc:
+            # a gang member that dies MID-DISPATCH surfaces on the
+            # survivors as the collective transport's runtime error (gloo
+            # connection reset / socket closed), not as a gang barrier
+            # timeout — same fate-sharing containment, same typed journal
+            # row, so the bucket's requests requeue with their parked
+            # progress immediately instead of waiting for the next
+            # incarnation's lease sweep.
+            if self._gang_active is not None and _transport_death(exc):
+                self._disarm_device_fence(drain=False)
+                info = self._gang_active
+                self._contain_gang_loss(
+                    key,
+                    slots,
+                    GangMemberLost(str(info.get("gang", "?")), None, str(exc)),
+                )
+            raise
         finally:
             self._global_step = runner.step
             self._runner = None
@@ -1115,27 +1419,234 @@ class SimServer:
                 "serve_fleet_devices_busy",
                 "devices executing campaign work right now",
             ).set(0)
+            self._close_gang()
             # hand the bucket lease back (root's file, host-local IO —
             # safe on the exception path too).  The release is ordered
             # AFTER every queue write of this campaign; a fenced lease
             # (LeaseLost) means a survivor already owns the bucket.
-            if self._fleet is not None and self._lease is not None:
-                from .fleet.lease import LeaseLost
-
-                with self._hb_lock:
-                    lease, self._lease = self._lease, None
-                if lease is not None:
-                    try:
-                        lease.release()
-                        self._journal(
-                            {"event": "lease_released", "bucket": lease.tag}
-                        )
-                    except LeaseLost:
-                        self._journal(
-                            {"event": "lease_fenced", "bucket": lease.tag}
-                        )
+            self._release_bucket_lease()
             self._fenced = False
-        self._sync("serve-campaign-close")
+            self._disarm_device_fence()
+        self._gang_fence("serve-campaign-close")
+
+    def _release_bucket_lease(self) -> None:
+        if self._fleet is None or self._lease is None:
+            return
+        from .fleet.lease import LeaseLost
+
+        with self._hb_lock:
+            lease, self._lease = self._lease, None
+        if lease is not None:
+            try:
+                lease.release()
+                self._journal(
+                    {"event": "lease_released", "bucket": lease.tag}
+                )
+            except LeaseLost:
+                self._journal(
+                    {"event": "lease_fenced", "bucket": lease.tag}
+                )
+
+    # -- gang campaigns (two-level serving) -----------------------------------
+
+    def _gang_fence(self, tag: str) -> None:
+        """The campaign open/close fence: the plain sync for ordinary
+        campaigns, the GANG barrier (its own watchdog,
+        ``RUSTPDE_GANG_SYNC_TIMEOUT_S`` -> typed
+        :class:`~rustpde_mpi_tpu.serve.fleet.gang.GangMemberLost`) while a
+        gang campaign is open — a member SIGKILLed between fences surfaces
+        structured instead of wedging every survivor."""
+        if self._gang_active is None:
+            self._sync(tag)
+            return
+        if self._nproc() == 1:
+            return
+        from .fleet import gang as _gang
+
+        _gang.gang_sync(
+            tag,
+            str(self._gang_active["gang"]),
+            member=self._gang_active.get("member"),
+        )
+
+    def _open_gang(self, key: tuple) -> bool:
+        """Open the gang chapter of a sub-mesh campaign: resolve the
+        placement the model build made, form the fate-shared lease group
+        (fleet mode, root — one group lease + one fencing token per
+        member), bind the fault-injection scope, journal ``gang_formed``
+        (plus ``gang_replanned`` when the carve re-mapped a stamped
+        bucket).  True for ordinary campaigns (nothing happens) and for a
+        formed gang; False when formation lost the claim race — the
+        verdict is root-broadcast, so every host refuses together."""
+        self._gang_active = None
+        shape = _sm.key_shape(key) if self._submesh is not None else 0
+        if shape <= 0:
+            return True
+        sub, replanned = self._gang_placement or (None, False)
+        gindex = int(sub.index) if sub is not None else 0
+        try:
+            import jax
+
+            member = int(jax.process_index())
+        except Exception:
+            member = 0
+
+        def plan_open():
+            out = {"formed": True, "generation": None}
+            if self._fleet is not None:
+                from .fleet.gang import GangLease
+
+                glease = GangLease.form(
+                    self._lease_mgr, key, self._nproc()
+                )
+                if glease is None:
+                    out["formed"] = False
+                else:
+                    with self._hb_lock:
+                        self._gang_lease = glease
+                    out["generation"] = glease.generation
+            return out
+
+        plan = self._root_plan(plan_open)
+        if not plan["formed"]:
+            self._journal(
+                {"event": "gang_form_failed", "key": list(key), "gang": gindex}
+            )
+            return False
+        self._gang_active = {
+            "gang": gindex,
+            "member": member,
+            "shape": int(shape),
+            "devices": int(len(sub.devices)) if sub is not None else 0,
+            "generation": plan["generation"],
+        }
+        if self._fault is not None:
+            self._fault.bind_gang(gindex, member)
+        self._gangs_formed += 1
+        _tm.counter(
+            "serve_gangs_formed_total", "gang campaigns formed"
+        ).inc()
+        self._journal(
+            {
+                "event": "gang_formed",
+                "key": list(key),
+                "gang": gindex,
+                "shape": int(shape),
+                "devices": self._gang_active["devices"],
+                "members": self._nproc(),
+                "generation": plan["generation"],
+            }
+        )
+        if replanned:
+            # elastic re-carve: the fleet no longer holds the stamped
+            # shape — the bucket was re-placed on what fits now
+            self._journal(
+                {
+                    "event": "gang_replanned",
+                    "key": list(key),
+                    "gang": gindex,
+                    "stamped": int(shape),
+                    "devices": self._gang_active["devices"],
+                }
+            )
+        return True
+
+    def _close_gang(self) -> None:
+        """Host-local gang teardown on every campaign exit path: unbind
+        the fault scope, zero the per-gang gauges, release the lease
+        group (LeaseLost = a survivor already broke us: fine, its
+        cleanup is authoritative)."""
+        if self._gang_active is None:
+            return
+        info, self._gang_active = self._gang_active, None
+        if self._fault is not None:
+            self._fault.bind_gang(None, None)
+        _tm.gauge(
+            "serve_gang_mfu",
+            "model-flops utilization per gang sub-mesh",
+            gang=str(info["gang"]),
+        ).set(0.0)
+        if self._fleet is None:
+            return
+        from .fleet.lease import LeaseLost
+
+        with self._hb_lock:
+            glease, self._gang_lease = self._gang_lease, None
+        if glease is not None:
+            try:
+                glease.release()
+            except (LeaseLost, OSError):
+                pass  # broken by containment or a surviving peer
+
+    def _contain_gang_loss(self, key: tuple, slots: list[_Slot], exc) -> None:
+        """Gang-death containment, HOST-LOCAL ONLY — a member is dead, so
+        not one collective may run here.  Root journals the typed loss,
+        requeues every running slot WITH the progress its durable parked
+        continuation carries (the cadence persist is the real resume
+        state; the device runtime may be wedged and is never touched),
+        and breaks ONLY this gang's lease group so the next incarnation
+        reclaims immediately instead of waiting out a TTL.  Queue writes
+        happen only under a live bucket lease (fencing: a survivor that
+        broke us already requeued these requests itself)."""
+        info = self._gang_active or {}
+        self._gang_members_lost += 1
+        _tm.counter(
+            "serve_gang_members_lost_total",
+            "gang members lost (barrier watchdog / dispatch hang)",
+        ).inc()
+        if not self._is_root():
+            return
+        self._journal(
+            {
+                "event": "gang_member_lost",
+                "key": list(key),
+                "gang": info.get("gang"),
+                "member": getattr(exc, "member", None),
+                "generation": info.get("generation"),
+                "detail": str(exc),
+            }
+        )
+        if self._fleet is not None:
+            from .fleet.lease import LeaseLost
+
+            with self._hb_lock:
+                lease = self._lease
+            try:
+                if lease is not None:
+                    lease.guard()
+            except LeaseLost:
+                return
+        for s in slots:
+            if not s.running:
+                continue
+            progress, parked = int(s.base), False
+            meta = checkpoint.continuation_meta(
+                checkpoint.continuation_dir(self.cfg.run_dir, s.req.id)
+            )
+            if meta is not None:
+                progress, parked = int(meta[0]), True
+            self.queue.requeue(
+                dataclasses.replace(s.req, progress=progress)
+            )
+            self._journal(
+                {
+                    "event": "request_requeued",
+                    "id": s.req.id,
+                    "trace_id": s.req.trace_id,
+                    "slot": s.index,
+                    "progress": progress,
+                    "target": s.target,
+                    "parked": parked,
+                    "checkpoint": None,
+                    "gang": info.get("gang"),
+                }
+            )
+        if self._fleet is not None:
+            from .fleet.gang import break_gang
+
+            break_gang(self._lease_mgr, key, self._nproc())
+            with self._hb_lock:
+                self._gang_lease = None
 
     def _try_resume(self, runner) -> None:
         """Campaign restore with graceful degradation: a checkpoint that no
@@ -1252,6 +1763,8 @@ class SimServer:
         new_ens.mark_dead(range(new_ens.k))
         new_ens.io_pipeline = getattr(ens, "io_pipeline", None)
         runner.pde = new_ens
+        if self._fence_ens is not None:
+            self._fence_ens = new_ens
         return runner, new_ens
 
     def _replan_fleet(
@@ -1343,6 +1856,8 @@ class SimServer:
                     }
                 )
         runner.pde = new_ens
+        if self._fence_ens is not None:
+            self._fence_ens = new_ens
         self._replans += 1
         _tm.counter(
             "serve_replans_total", "elastic fleet re-plans across restarts"
@@ -1381,13 +1896,6 @@ class SimServer:
         _tm.gauge(
             "serve_slot_utilization", "running slots / campaign slot count"
         ).set(util)
-        # fleet-level view (the mesh-sharded-serve item's gate gauges):
-        # today one campaign spans every device, so busy-devices is all-or-
-        # nothing; sub-mesh campaigns will report their own share here
-        _tm.gauge(
-            "serve_fleet_utilization",
-            "running-slot fraction of the fleet (0 between campaigns)",
-        ).set(util)
         try:
             import jax
 
@@ -1398,6 +1906,20 @@ class SimServer:
             devices = int(jax.local_device_count())
         except Exception:
             devices = 1
+        # fleet-level view (the mesh-sharded-serve item's gate gauges): a
+        # single-level campaign spans every device (all-or-nothing); a
+        # sub-mesh campaign reports only ITS slice's share of the fleet,
+        # so co-resident gauges sum to the true fleet utilization
+        fleet_util = util
+        if self._active_share is not None:
+            mine, local_total = self._active_share
+            if local_total:
+                fleet_util = util * (mine / local_total)
+            devices = mine
+        _tm.gauge(
+            "serve_fleet_utilization",
+            "running-slot fraction of the fleet (0 between campaigns)",
+        ).set(fleet_util)
         _tm.gauge(
             "serve_fleet_devices_busy",
             "devices executing campaign work right now",
@@ -1593,11 +2115,22 @@ class SimServer:
             if self._flops_member:
                 from ..utils.profiling import PEAK_FLOPS, peak_flops_key
 
+                mfu = (
+                    self._flops_member * rate / PEAK_FLOPS[peak_flops_key()]
+                )
                 _tm.gauge(
                     "serve_mfu",
                     "model-flops utilization per compat bucket",
                     bucket=self._bucket_tag,
-                ).set(self._flops_member * rate / PEAK_FLOPS[peak_flops_key()])
+                ).set(mfu)
+                if self._gang_active is not None:
+                    # the per-gang view of the same quantity: one labeled
+                    # series per carved sub-mesh, zeroed at campaign close
+                    _tm.gauge(
+                        "serve_gang_mfu",
+                        "model-flops utilization per gang sub-mesh",
+                        gang=str(self._gang_active["gang"]),
+                    ).set(mfu)
         self._rate_mark = (now, self._member_steps)
         _cl.update_device_memory_gauges()
 
@@ -1859,6 +2392,14 @@ class SimServer:
 
                 stats_fut = ens.stats_health_async()
                 stats_names = HEALTH_NAMES
+            if self._fence_ens is not None:
+                # EVERY host stashes the dispatch handles for the sub-mesh
+                # fence (root alone keeps them in _pending_results): the
+                # lanes refill right after this, so the ensemble's obs
+                # cache rebinds and can no longer fence THESE programs
+                self._inflight_futs.append(obs_fut)
+                if stats_fut is not None:
+                    self._inflight_futs.append(stats_fut)
             batch = []
             for d in plan["finished"]:
                 s = slots[d["slot"]]
@@ -1915,7 +2456,15 @@ class SimServer:
                 state,
                 base=int(base),
                 time_base=float(time_base),
-                meta={"id": req.id, "dt": float(req.dt)},
+                meta={
+                    "id": req.id,
+                    "dt": float(req.dt),
+                    # the sub-mesh stamp rides the manifest so a resuming
+                    # gang can verify the parked shards' topology matches
+                    # the bucket it re-forms under (checkpoint.
+                    # continuation_record reads it back)
+                    "submesh": int(getattr(req, "submesh", 0)),
+                },
             )
         except (checkpoint.CheckpointError, OSError) as exc:
             # degrade to the PR-10 behavior (in-memory park + queued
@@ -1985,6 +2534,26 @@ class SimServer:
         back would hand different lanes different states) — so success is
         journaled there, not here."""
         cdir = checkpoint.continuation_dir(self.cfg.run_dir, req.id)
+        rec = checkpoint.continuation_record(cdir)
+        if rec is not None:
+            # topology fence for gang parks: a SHARDED continuation only
+            # resumes into a bucket of the same sub-mesh stamp — a fleet
+            # that re-carved under the park degrades to a fresh
+            # trajectory instead of reading shards at the wrong geometry
+            want = int(getattr(req, "submesh", 0) or 0)
+            got = int((rec.get("meta") or {}).get("submesh", 0) or 0)
+            if got != want:
+                self._journal(
+                    {
+                        "event": "continuation_restore_failed",
+                        "id": req.id,
+                        "error": (
+                            f"sub-mesh stamp mismatch: parked at {got}, "
+                            f"bucket wants {want}"
+                        ),
+                    }
+                )
+                return None
         template = ens.member_state(slot_index)
         try:
             state, _, _ = checkpoint.read_continuation(cdir, template)
@@ -2322,6 +2891,19 @@ class SimServer:
                     state,
                     s.base + int(done[s.index]),
                     s.time_base + int(done[s.index]) * float(s.req.dt),
+                )
+            if self._gang_active is not None:
+                # the gang's SHARDED state just went through the same
+                # two-phase continuation writer (one shard per member):
+                # the whole gang parks as a unit inside the notice window
+                self._journal(
+                    {
+                        "event": "gang_parked",
+                        "key": list(key),
+                        "gang": self._gang_active.get("gang"),
+                        "generation": self._gang_active.get("generation"),
+                        "slots": len(running),
+                    }
                 )
         for s in running:
             req = dataclasses.replace(

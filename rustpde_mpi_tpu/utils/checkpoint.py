@@ -1483,6 +1483,26 @@ def continuation_meta(cont_dir: str) -> tuple[int, float] | None:
         return None
 
 
+def continuation_record(cont_dir: str) -> dict | None:
+    """The full committed-continuation manifest record (progress, shard
+    table, writer-supplied ``meta`` — request id, dt, and the sub-mesh
+    stamp a gang park carries), host-side JSON only; None when no
+    committed continuation exists.  The gang recovery path reads this to
+    verify a parked SHARDED state's topology (``meta.submesh``,
+    ``len(shards)``) matches the bucket re-forming over it, and the
+    chaos-soak gates assert reclaimed-with-state through it."""
+    try:
+        with open(
+            os.path.join(cont_dir, CONTINUATION_MANIFEST), encoding="utf-8"
+        ) as fh:
+            record = json.load(fh)
+    except (OSError, ValueError):
+        return None
+    if "base" not in record or "time_base" not in record:
+        return None
+    return record
+
+
 def write_continuation(
     cont_dir: str, state, *, base: int, time_base: float, meta: dict | None = None
 ) -> str:

@@ -44,6 +44,35 @@ def _parse_host_scope(token: str, spec: str, expected: str) -> int:
     return int(token[4:])
 
 
+def _parse_gang_scope(
+    token: str, spec: str, expected: str
+) -> tuple[int, int | None]:
+    """``gang<g>`` or ``gang<g>member<m>`` -> (gang, member).  STRICT:
+    anything else (missing indices, trailing junk) is a typed
+    :class:`FaultSpecError` — a chaos soak whose kill silently never
+    scopes is a green lie."""
+    body = token[len("gang"):]
+    gang_digits, sep, member_part = body.partition("member")
+    if not gang_digits.isdigit():
+        raise FaultSpecError(
+            spec, expected,
+            f"bad gang scope {token!r}, expected gang<g>[member<m>]",
+        )
+    if not sep:
+        if member_part:
+            raise FaultSpecError(
+                spec, expected,
+                f"bad gang scope {token!r}, expected gang<g>[member<m>]",
+            )
+        return int(gang_digits), None
+    if not member_part.isdigit():
+        raise FaultSpecError(
+            spec, expected,
+            f"bad gang scope {token!r}, expected gang<g>[member<m>]",
+        )
+    return int(gang_digits), int(member_part)
+
+
 @dataclasses.dataclass
 class FaultPlan:
     """Parsed ``RUSTPDE_FAULT`` spec ``<kind>@<step>[:host<p>]``: inject
@@ -71,6 +100,15 @@ class FaultPlan:
     * ``slow``  — stall the next dispatch past the watchdog deadline (the
       ``DispatchHang`` path); host-scoped, only that host stalls.
 
+    GANG scope (``:gang<g>`` or ``:gang<g>member<m>``, two-level serving):
+    the fault acts only inside the gang campaign the scheduler BINDS at
+    open (:meth:`bind_gang` — ``g`` is the carved sub-mesh index, ``m``
+    the process's member rank within the gang).  A gang-scoped ``kill``
+    is a hard ``SIGKILL`` like a host-scoped one: the exact dead-gang-
+    member shape the gang barrier watchdog
+    (``RUSTPDE_GANG_SYNC_TIMEOUT_S``) must convert into a typed
+    ``GangMemberLost``.  Outside any bound gang the fault never acts.
+
     The two-phase checkpoint WINDOW faults (kill between shard fsync and
     manifest commit) are a separate hook — ``RUSTPDE_SHARD_CRASH``, parsed
     by :func:`parse_shard_crash_spec` — because they key on a phase of the
@@ -79,17 +117,23 @@ class FaultPlan:
     kind: str
     step: int
     host: int | None = None
+    gang: int | None = None
+    member: int | None = None
     fired: bool = False
+    # runtime binding (not part of the spec): the scheduler sets these at
+    # gang-campaign open and clears them at close — None = not in a gang
+    bound_gang: int | None = None
+    bound_member: int | None = None
 
     KINDS = FAULT_KINDS
-    EXPECTED = "<nan|spike|kill|slow>@<step>[:host<p>]"
+    EXPECTED = "<nan|spike|kill|slow>@<step>[:host<p>|:gang<g>[member<m>]]"
 
     @classmethod
     def from_spec(cls, spec: str | None) -> "FaultPlan | None":
         if not spec:
             return None
         kind, sep, rest = spec.partition("@")
-        at, hsep, host = rest.partition(":")
+        at, hsep, scope = rest.partition(":")
         if kind not in cls.KINDS or not sep:
             raise FaultSpecError(spec, cls.EXPECTED, f"unknown kind {kind!r}")
         try:
@@ -98,15 +142,30 @@ class FaultPlan:
             raise FaultSpecError(
                 spec, cls.EXPECTED, f"bad step {at!r}, expected an integer"
             ) from None
-        return cls(
-            kind=kind,
-            step=step,
-            host=_parse_host_scope(host, spec, cls.EXPECTED) if hsep else None,
-        )
+        host = gang = member = None
+        if hsep:
+            if scope.startswith("gang"):
+                gang, member = _parse_gang_scope(scope, spec, cls.EXPECTED)
+            else:
+                host = _parse_host_scope(scope, spec, cls.EXPECTED)
+        return cls(kind=kind, step=step, host=host, gang=gang, member=member)
+
+    def bind_gang(self, gang: int | None, member: int | None) -> None:
+        """Bind (or, with Nones, unbind) the running gang campaign: the
+        serve scheduler calls this at gang-campaign open/close so a
+        gang-scoped spec can resolve "am I the target?" locally."""
+        self.bound_gang = gang
+        self.bound_member = member
 
     def scoped_here(self) -> bool:
         """True when this process must ACT on the fault (unscoped, or the
-        scope names this process)."""
+        scope names this process / this bound gang member)."""
+        if self.gang is not None:
+            if self.bound_gang != self.gang:
+                return False
+            if self.member is not None:
+                return self.bound_member == self.member
+            return True
         if self.host is None:
             return True
         try:

@@ -1106,33 +1106,51 @@ class ResilientRunner:
 
     def _dispatch(self, pde, n: int) -> None:
         fault = self.fault
+        fire_at = None
         if (
             fault is not None
             and not fault.fired
-            and self.step < fault.step <= self.step + n
+            and (fault.gang is None or fault.bound_gang == fault.gang)
         ):
-            pre = fault.step - self.step
+            # a GANG-scoped plan is consumed only while its gang campaign
+            # is bound (the serve scheduler's bind_gang at open): the step
+            # threshold crossing during some other bucket's campaign must
+            # not burn the trigger as a silent no-op.  If the matching
+            # campaign opens after the threshold already passed, the plan
+            # fires on its first gang dispatch instead — still
+            # collectively aligned, because the gang binding verdict was
+            # root-broadcast at campaign open.
+            if self.step < fault.step <= self.step + n:
+                fire_at = fault.step
+            elif fault.gang is not None and fault.step <= self.step:
+                fire_at = self.step
+        if fire_at is not None:
+            pre = fire_at - self.step
             if pre > 0:
                 self._advance(pde, pre)
-            if self.step != fault.step:
+            if self.step != fire_at:
                 return  # pre-advance stopped early (signal); fire later
             fault.fired = True
             _tr.instant("fault_injected", kind=fault.kind, step=self.step)
-            self._journal(
-                {"event": "fault_injected", "kind": fault.kind, "host": fault.host}
-            )
+            row = {"event": "fault_injected", "kind": fault.kind,
+                   "host": fault.host}
+            if fault.gang is not None:
+                row["gang"] = fault.gang
+                row["member"] = fault.member
+            self._journal(row)
             if fault.kind == "nan":
                 # host-scoped or not, EVERY process dispatches the same
                 # (masked) poison computation — collective consistency
                 poison_state(pde, host=fault.host)
                 return  # run is over either way; exit() fires at the boundary
             if fault.kind == "kill":
-                if fault.host is None:
+                if fault.host is None and fault.gang is None:
                     os.kill(os.getpid(), signal.SIGTERM)
                 elif fault.scoped_here():
-                    # hard single-host death (no checkpoint-then-exit): the
-                    # survivors wedge at the next collective, which the
-                    # sync watchdog converts into a structured DispatchHang
+                    # hard single-host (or gang-member) death, no
+                    # checkpoint-then-exit: the survivors wedge at the next
+                    # collective, which the sync watchdog — or the gang
+                    # barrier watchdog — converts into a structured hang
                     os.kill(os.getpid(), signal.SIGKILL)
             elif fault.kind == "slow":
                 if fault.scoped_here():

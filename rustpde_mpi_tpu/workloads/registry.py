@@ -66,7 +66,10 @@ def build_model(
 def build_model_for_key(key: tuple, *, mesh=None):
     """Build the campaign model one compat-key bucket needs (the serve
     scheduler's campaign constructor): ``key`` is the 10-tuple
-    ``(kind, nx, ny, ra, pr, dt, aspect, bc, periodic, scenario_sig)``.
+    ``(kind, nx, ny, ra, pr, dt, aspect, bc, periodic, scenario_sig)``,
+    or the 11-tuple SERVE key with the sub-mesh stamp appended
+    (two-level serving) — the stamp selects the mesh upstream and is
+    stripped here; the model's own compat key stays the 10-tuple.
 
     This is THE model-build/jit seam for every bucket, so compile
     attribution hangs here: build wall time and the recompile count are
@@ -77,6 +80,9 @@ def build_model_for_key(key: tuple, *, mesh=None):
     from ..telemetry import compile_log
 
     t0 = _time.perf_counter()
+    key = tuple(key)
+    if len(key) == 11:
+        key = key[:10]
     kind, nx, ny, ra, pr, dt, aspect, bc, periodic, scenario_sig = key
     scenario = dict(scenario_sig) if scenario_sig else None
     if scenario and "passive_scalar" in scenario:

@@ -117,6 +117,12 @@ def main() -> int:
         # p99, loss gates) — the control loop gets the same tracked
         # record the fleet it drives has
         "autoscale": _autoscale_counters(),
+        # gang-scheduled sub-mesh serving counters from the
+        # serve_submesh129 chaos pair (gang formations, typed member
+        # losses, reclaim/requeue trajectory, solo-parity and co-resident
+        # latency gates) — two-level serving gets the same tracked record
+        # the flat multihost scheduler has
+        "gang_serve": _gang_serve_counters(),
         # per-model solo-vs-ensemble parity deltas (workloads satellite):
         # recorded into PARITY.json too, so cross-model vmap/scan drift
         # shows up per-PR next to the Nu-parity numbers
@@ -324,6 +330,44 @@ def _autoscale_counters() -> dict | None:
             )
             if key in row
         }
+    except (OSError, ValueError, KeyError):
+        return None
+
+
+def _gang_serve_counters() -> dict | None:
+    """Two-level serving counters from BENCH_FULL.json's
+    ``serve_submesh129`` row (clean baseline + gang-kill chaos pair on
+    the 2-process sub-mesh harness): gang formations, typed member
+    losses, the reclaim trajectory and the zero-lost / solo-parity /
+    co-resident-latency gates.  None when the config was never benched —
+    or predates gang scheduling."""
+    try:
+        with open(os.path.join(_REPO, "BENCH_FULL.json")) as f:
+            row = json.load(f)["results"]["serve_submesh129"]
+        out = {
+            key: row.get(key)
+            for key in (
+                "requests_gang",
+                "requests_vmapped",
+                "coresident_p99_factor",
+                "solo_rel_err_max",
+                "zero_lost",
+                "gang_killed",
+                "gang_reclaimed",
+                "solo_ok",
+                "coresident_ok",
+                "error",
+            )
+            if key in row
+        }
+        chaos = row.get("chaos")
+        if isinstance(chaos, dict):
+            out["gang_formed"] = chaos.get("gang_formed")
+            out["gang_member_lost"] = chaos.get("gang_member_lost")
+            out["restored_mid_trajectory"] = chaos.get(
+                "restored_mid_trajectory"
+            )
+        return out
     except (OSError, ValueError, KeyError):
         return None
 

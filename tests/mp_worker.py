@@ -26,6 +26,19 @@ One process of an N-process ``jax.distributed`` run on CPU devices.  Modes
   ``RUSTPDE_FAULT`` (SIGTERM drain, host-scoped SIGKILL, batch NaN) and
   the slot count from ``RUSTPDE_MP_SERVE_SLOTS`` so restarts can resize
   the fleet (elastic re-plan).  Root dumps summary + journal counters.
+* ``gang_serve`` — TWO-LEVEL serving over the same 2-process mesh:
+  ``ServeConfig.submesh`` carves the 4 CPU devices into one 2-device
+  gang sub-mesh plus a 2-device default remainder, and root enqueues
+  MIXED traffic — ``RUSTPDE_MP_GANG_REQUESTS`` pencil-sharded 34^2
+  flagship requests (stamped ``submesh=2`` at admission) interleaved
+  with ``RUSTPDE_MP_VMAP_REQUESTS`` vmapped 18^2 requests riding the
+  remainder.  Gang-scoped faults (``RUSTPDE_FAULT=kill@<n>:gang0member1``)
+  SIGKILL one gang member mid-campaign; the gang barrier watchdog
+  (``RUSTPDE_GANG_SYNC_TIMEOUT_S``) must convert the wedge into a typed
+  ``GangMemberLost`` and containment must requeue-with-state.  Root also
+  proves door-time admission: an unshardable grid comes back as a typed
+  ``reason="no_submesh"`` rejection, never a durable queue row.  Root
+  dumps summary + the gang journal counters.
 
 argv: coordinator_port process_id num_processes out_dir [mode]
 """
@@ -360,6 +373,130 @@ def mode_serve_campaign(out_dir):
             )
 
 
+def mode_gang_serve(out_dir):
+    from rustpde_mpi_tpu.config import ServeConfig, SubmeshConfig
+    from rustpde_mpi_tpu.parallel import multihost
+    from rustpde_mpi_tpu.serve import AdmissionError, SimServer
+    from rustpde_mpi_tpu.serve.request import RequestError
+    from rustpde_mpi_tpu.utils.journal import read_journal
+
+    n_gang = int(os.environ.get("RUSTPDE_MP_GANG_REQUESTS", "2"))
+    n_vmap = int(os.environ.get("RUSTPDE_MP_VMAP_REQUESTS", "3"))
+    slots = int(os.environ.get("RUSTPDE_MP_SERVE_SLOTS", "2"))
+    run_dir = os.path.join(out_dir, "serve")
+    cfg = ServeConfig(
+        run_dir=run_dir,
+        slots=slots,
+        max_queue=4 * (n_gang + n_vmap) + 8,
+        chunk_steps=4,
+        checkpoint_every_s=2.0,  # tight cadence: the gang SIGKILL must
+        # leave a recent sharded slot-table checkpoint to restore from
+        http_port=None,
+        # 4 CPU devices, 2 processes: one 2-device gang slice (one device
+        # from each process) + a 2-device default remainder.  34^2 is the
+        # smallest grid whose spectral extent (32) divides the slice, so
+        # shard_min_nx=34 makes it the flagship gang traffic.
+        submesh=SubmeshConfig(shapes=(2,), shard_min_nx=34),
+    )
+    srv = SimServer(cfg)  # fault rides RUSTPDE_FAULT (gang scopes ok)
+    if multihost.is_root():
+        counts = srv.queue.counts()
+        if sum(counts.values()) == 0:  # first incarnation only
+            for seed in range(n_gang):
+                # flagship sharded traffic: stamped submesh=2 at the door
+                try:
+                    srv.submit(
+                        {
+                            "ra": 1e4,
+                            "pr": 1.0,
+                            "nx": 34,
+                            "ny": 34,
+                            "dt": 0.01,
+                            "horizon": 0.08 + (seed % 2) * 0.04,
+                            "seed": 100 + seed,
+                        }
+                    )
+                except AdmissionError:
+                    pass
+            for seed in range(n_vmap):
+                # co-resident vmapped traffic on the default remainder
+                try:
+                    srv.submit(
+                        {
+                            "ra": 1e4,
+                            "pr": 1.0,
+                            "nx": 18,
+                            "ny": 18,
+                            "dt": 0.01,
+                            "horizon": 0.08 + (seed % 3) * 0.02,
+                            "seed": seed,
+                        }
+                    )
+                except AdmissionError:
+                    pass
+            # admission containment (PR-18 satellite): a grid that must
+            # shard but fits no configured shape is a typed door-time
+            # rejection, never a durable poison pill in the queue
+            reason = None
+            try:
+                srv.submit(
+                    {
+                        "ra": 1e4,
+                        "pr": 1.0,
+                        "nx": 259,
+                        "ny": 259,
+                        "dt": 0.01,
+                        "horizon": 0.02,
+                        "seed": 999,
+                    }
+                )
+            except (RequestError, ValueError) as exc:
+                reason = getattr(exc, "reason", None)
+            assert reason == "no_submesh", reason
+    summary = srv.serve()
+    if multihost.is_root():
+        events = [
+            e.get("event")
+            for e in read_journal(
+                os.path.join(run_dir, "journal.jsonl"), on_error="skip"
+            )
+        ]
+        with open(os.path.join(out_dir, "result.json"), "w") as f:
+            json.dump(
+                {
+                    "outcome": summary["outcome"],
+                    "completed": summary["completed"],
+                    "failed": summary["failed"],
+                    "retried": summary["retried"],
+                    "replans": summary["replans"],
+                    "queue": srv.queue.counts(),
+                    "slots": slots,
+                    "nproc": jax.process_count(),
+                    "gang_formed": events.count("gang_formed"),
+                    "gang_member_lost": events.count("gang_member_lost"),
+                    "gang_parked": events.count("gang_parked"),
+                    "gang_replanned": events.count("gang_replanned"),
+                    "gang_form_failed": events.count("gang_form_failed"),
+                    "submesh_rejected": events.count("submesh_rejected"),
+                    "drains": events.count("drain"),
+                    "requeued": events.count("request_requeued"),
+                    "replanned": events.count("campaign_replanned"),
+                    "retries": events.count("request_retry"),
+                    "restored_sched": sum(
+                        1
+                        for e in read_journal(
+                            os.path.join(run_dir, "journal.jsonl"),
+                            on_error="skip",
+                        )
+                        if e.get("event") == "request_scheduled"
+                        and e.get("restored")
+                        and e.get("steps_done", 0) > 0
+                    ),
+                },
+                f,
+            )
+
+
 def mode_sanitize_desync(out_dir):
     """Collective-sequence sanitizer exercise (tests/test_sanitizer.py).
 
@@ -410,18 +547,27 @@ def main():
     )
     assert started and jax.process_count() == nproc
 
-    if mode == "basic":
-        mode_basic(out_dir)
-    elif mode == "sharded_run":
-        mode_sharded_run(out_dir)
-    elif mode == "bench_sharded":
-        mode_bench_sharded(out_dir)
-    elif mode == "serve_campaign":
-        mode_serve_campaign(out_dir)
-    elif mode == "sanitize_desync":
-        mode_sanitize_desync(out_dir)
-    else:
+    modes = {
+        "basic": mode_basic,
+        "sharded_run": mode_sharded_run,
+        "bench_sharded": mode_bench_sharded,
+        "serve_campaign": mode_serve_campaign,
+        "gang_serve": mode_gang_serve,
+        "sanitize_desync": mode_sanitize_desync,
+    }
+    if mode not in modes:
         raise SystemExit(f"unknown mode {mode!r}")
+    try:
+        modes[mode](out_dir)
+    except BaseException:
+        # durable per-rank traceback: a peer's abort can kill this process
+        # mid-stderr-print, so the parent test would otherwise never see
+        # WHICH exception started the cascade
+        import traceback
+
+        with open(os.path.join(out_dir, f"rank{pid}.err"), "w") as f:
+            traceback.print_exc(file=f)
+        raise
     print(f"RANK{pid} OK", flush=True)
 
 

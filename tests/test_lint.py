@@ -326,6 +326,85 @@ def loop(self, runner, n):
     )
 
 
+# -- RPD009: dispatch after lease renewal without a fence consult -------------
+
+
+def test_rpd009_dispatch_after_renew_without_fence_flagged():
+    # the PR-18 review shape: a renew can raise LeaseLost and leave the
+    # replica fenced; the next barrier races the reclaimer
+    src = '''
+def boundary(self, runner, n):
+    self._lease.renew()
+    sync_hosts("chunk-boundary")
+'''
+    found = lint_source(src, "rustpde_mpi_tpu/serve/scheduler.py")
+    assert "RPD009" in rules_of(found)
+    (f,) = [f for f in found if f.rule == "RPD009"]
+    assert "fencing check" in f.message
+
+
+def test_rpd009_fence_check_between_passes():
+    src = '''
+def boundary(self, runner, ens, slots, key, n):
+    self._fleet_heartbeat()
+    if self._fence_check(ens, slots, key):
+        return
+    runner.update_n(n)
+'''
+    assert "RPD009" not in rules_of(
+        lint_source(src, "rustpde_mpi_tpu/serve/scheduler.py")
+    )
+
+
+def test_rpd009_fenced_flag_read_counts_as_consult():
+    src = '''
+def boundary(self, runner, n):
+    self._lease.renew()
+    fenced = broadcast_obj(self._fenced)
+    if fenced:
+        return
+    runner.update_n(n)
+'''
+    assert "RPD009" not in rules_of(
+        lint_source(src, "rustpde_mpi_tpu/serve/scheduler.py")
+    )
+
+
+def test_rpd009_guard_counts_as_consult():
+    src = '''
+def requeue(self, lease, queue, req):
+    lease.renew()
+    lease.guard()
+    sync_hosts("requeue")
+'''
+    assert "RPD009" not in rules_of(
+        lint_source(src, "rustpde_mpi_tpu/serve/fleet/gang.py")
+    )
+
+
+def test_rpd009_dispatch_before_renew_not_flagged():
+    # the renew ends the region; dispatches before it are not in it
+    src = '''
+def boundary(self, runner, n):
+    sync_hosts("chunk-boundary")
+    self._lease.renew()
+'''
+    assert "RPD009" not in rules_of(
+        lint_source(src, "rustpde_mpi_tpu/serve/scheduler.py")
+    )
+
+
+def test_rpd009_out_of_scope_module_not_flagged():
+    src = '''
+def boundary(self, runner, n):
+    self._lease.renew()
+    sync_hosts("chunk-boundary")
+'''
+    assert "RPD009" not in rules_of(
+        lint_source(src, "rustpde_mpi_tpu/tools/fixture.py")
+    )
+
+
 # -- generic layer ------------------------------------------------------------
 
 

@@ -277,6 +277,124 @@ def test_multiprocess_serve_campaign_chaos_soak(tmp_path):
         assert abs(res["nu"] - solo) <= 1e-9 * max(abs(solo), 1e-30)
 
 
+def _gang_solo_nu(record):
+    """Solo serial rerun of one served done record — EITHER grid class
+    (the 34^2 gang-sharded flagship or the 18^2 vmapped co-resident
+    bucket): two-level serving must stay member-, bucket- AND
+    topology-isolated."""
+    from rustpde_mpi_tpu import Navier2D
+
+    req, res = record["request"], record["result"]
+    m = Navier2D(
+        int(req["nx"]),
+        int(req["ny"]),
+        float(req["ra"]),
+        float(req["pr"]),
+        res["dt"],
+        1.0,
+        req.get("bc", "rbc"),
+        periodic=False,
+    )
+    m.init_random(res.get("amp") or 0.1, seed=res["seed"])
+    m.update_n(res["steps"])
+    return float(m.eval_nu())
+
+
+def test_multiprocess_gang_campaign_chaos_soak(tmp_path):
+    """THE two-level serving gate (PR-18 acceptance): mixed gang-sharded
+    (34^2 on the carved cross-process slice) and vmapped (18^2 on the
+    default remainder) traffic through three failure axes —
+
+    1. SIGTERM drain mid-campaign: the gang campaign parks its SHARDED
+       state through the two-phase continuation writer, unfinished
+       requests re-enqueue, both ranks exit clean;
+    2. gang-scoped SIGKILL (``kill@..:gang0member1``): one gang member
+       dies mid-sharded-chunk, fate-sharing converts the survivor's
+       wedged collective into typed ``GangMemberLost`` containment (a
+       ``gang_member_lost`` journal row + requeue-with-progress), and
+       the worker exits nonzero rather than wedging;
+    3. clean restart: a NEW gang forms, reclaims the broken gang's
+       requests at their parked progress, and the queue drains.
+
+    Zero requests lost or failed, and EVERY done record — both grid
+    classes, including the trajectories that crossed the gang kill —
+    matches a solo serial rerun to the serve isolation tolerance."""
+    from rustpde_mpi_tpu.utils.journal import read_journal
+
+    out_dir = str(tmp_path / "mpgang")
+    os.makedirs(out_dir, exist_ok=True)
+    n_gang = n_vmap = 2
+    base = {
+        "RUSTPDE_MP_GANG_REQUESTS": str(n_gang),
+        "RUSTPDE_MP_VMAP_REQUESTS": str(n_vmap),
+        "RUSTPDE_MP_SERVE_SLOTS": "2",
+        "RUSTPDE_SYNC_TIMEOUT_S": "60",
+        "RUSTPDE_DISPATCH_TIMEOUT_S": "60",
+        # the gang watchdog must convert the dead member WELL before the
+        # job-wide sync budget (failure-domain isolation, not a stall)
+        "RUSTPDE_GANG_SYNC_TIMEOUT_S": "30",
+        "RUSTPDE_SANITIZE": "1",
+    }
+
+    # phase 1: enqueue everything (gang + vmapped + the worker's in-line
+    # no_submesh rejection probe), SIGTERM drain at step 4
+    _spawn(out_dir, "gang_serve", env_extra={**base, "RUSTPDE_FAULT": "kill@4"})
+    with open(os.path.join(out_dir, "result.json")) as f:
+        r1 = json.load(f)
+    assert r1["outcome"] == "drained" and r1["requeued"] >= 1
+    assert r1["gang_formed"] >= 1
+    assert r1["submesh_rejected"] == 1  # typed 400 at the door, not queued
+    assert r1["failed"] == 0
+
+    # phase 2: gang member 1 SIGKILLed mid-gang-campaign — fate-sharing:
+    # BOTH ranks exit nonzero, containment journals the typed loss
+    outs = _spawn(
+        out_dir,
+        "gang_serve",
+        env_extra={**base, "RUSTPDE_FAULT": "kill@6:gang0member1"},
+        check=False,
+    )
+    assert outs[1][0] != 0, "gang member 1 should die at the SIGKILL fault"
+    assert outs[0][0] != 0, "root must not report success after losing its gang"
+
+    # phase 3: clean restart reclaims the broken gang's requests
+    _spawn(out_dir, "gang_serve", env_extra=base)
+    with open(os.path.join(out_dir, "result.json")) as f:
+        r3 = json.load(f)
+    n_all = n_gang + n_vmap
+    assert r3["outcome"] == "idle"
+    assert r3["queue"] == {
+        "queued": 0, "running": 0, "done": n_all, "failed": 0
+    }
+    assert r3["gang_formed"] >= 2  # a NEW gang formed after the loss
+    assert r3["gang_member_lost"] >= 1  # phase 2's typed containment row
+    assert r3["restored_sched"] >= 1  # trajectories restored mid-flight
+
+    events = read_journal(
+        os.path.join(out_dir, "serve", "journal.jsonl"), on_error="skip"
+    )
+    names = [e.get("event") for e in events]
+    assert "gang_formed" in names and "gang_member_lost" in names
+    assert "drain" in names and "request_requeued" in names
+    lost = [e for e in events if e.get("event") == "gang_member_lost"][-1]
+    assert lost.get("gang") is not None
+    requeues = [e for e in events if e.get("event") == "request_requeued"]
+    assert any(e.get("gang") is not None for e in requeues)
+
+    # loss-free + solo equivalence over EVERY done record, both grids
+    done_dir = os.path.join(out_dir, "serve", "queue", "done")
+    records = []
+    for name in sorted(os.listdir(done_dir)):
+        with open(os.path.join(done_dir, name)) as fh:
+            records.append(json.load(fh))
+    assert len(records) == n_all
+    assert {int(r["request"]["nx"]) for r in records} == {18, 34}
+    for rec in records:
+        solo = _gang_solo_nu(rec)
+        nu = rec["result"]["nu"]
+        assert abs(nu - solo) <= 1e-9 * max(abs(solo), 1e-30)
+
+
 def test_sharded_multiprocess_matches_serial_run(tmp_path):
     """A clean 2-process sharded-checkpoint run equals the serial model
     driven over the same horizon (the resilience layer must not perturb

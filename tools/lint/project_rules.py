@@ -32,6 +32,14 @@ caught (README "Static analysis & sanitizer" has the rule -> bug table):
   checks, randomness).  Instrumentation args that differ per host around
   a collective are the desync-by-instrumentation shape the runtime
   sanitizer can only catch once it has already happened.
+* RPD009 — a collective/dispatch call issued after a lease renewal with
+  no fencing check between them (PR 18 review, the gang-scheduling
+  shape): ``renew()`` raising ``LeaseLost`` marks the replica FENCED,
+  and the very next dispatch from a fenced replica races the
+  reclaimer's writes.  Any function that renews a fleet lease
+  (``renew``/``renew_member``/``_fleet_heartbeat``) must consult the
+  fence verdict (``_fence_check()``, ``lease.guard()`` or a read of
+  ``_fenced``) before its next collective.
 """
 
 from __future__ import annotations
@@ -669,6 +677,70 @@ def rule_span_collective_tag(module) -> list:
     return out
 
 
+# ---------------------------------- RPD009 dispatch after renew, no fence
+
+RENEW_CALLS = {"renew", "renew_member", "_fleet_heartbeat"}
+FENCE_CHECK_CALLS = {"_fence_check", "guard"}
+
+
+def rule_dispatch_after_renew_without_fence(module) -> list:
+    """RPD009: inside a lease-fenced scheduler region — a function that
+    renews a fleet lease — every collective/dispatch call lexically after
+    the renewal must have a fence consult between the renew and itself.
+
+    A renew that raises ``LeaseLost`` means a survivor broke this
+    replica's lease and already owns the bucket: the replica is FENCED,
+    and any dispatch it still issues (a barrier the reclaimer never
+    joins, a slot mutation racing the reclaimer's own) is the
+    split-brain shape the fencing tokens exist to kill.  The fence
+    consult is a call to ``_fence_check``/``guard`` or a read of the
+    ``_fenced`` flag."""
+    if not _in(module.relpath, MULTIHOST_MODULES):
+        return []
+    out = []
+    collective = COLLECTIVE_CALLS | DISPATCH_CALLS
+    for qualname, fn in _functions(module.tree):
+        renew_lines: list[int] = []
+        fence_lines: list[int] = []
+        dispatches: list[ast.Call] = []
+        for n in ast.walk(fn):
+            if isinstance(n, ast.Call):
+                name = _call_name(n)
+                if name in RENEW_CALLS:
+                    renew_lines.append(n.lineno)
+                elif name in FENCE_CHECK_CALLS:
+                    fence_lines.append(n.lineno)
+                elif name in collective:
+                    dispatches.append(n)
+            elif (
+                isinstance(n, ast.Attribute)
+                and n.attr == "_fenced"
+                and isinstance(n.ctx, ast.Load)
+            ):
+                fence_lines.append(n.lineno)
+        if not renew_lines or not dispatches:
+            continue
+        first_renew = min(renew_lines)
+        for n in dispatches:
+            if n.lineno <= first_renew:
+                continue
+            if any(first_renew <= f <= n.lineno for f in fence_lines):
+                continue
+            out.append(
+                module.finding(
+                    "RPD009",
+                    n,
+                    f"collective/dispatch '{_call_name(n)}' after a lease "
+                    "renewal with no fencing check between them — a renew "
+                    "that raised LeaseLost leaves this replica FENCED and "
+                    "its next dispatch races the reclaimer; consult "
+                    "_fence_check()/guard()/_fenced first",
+                    qualname,
+                )
+            )
+    return out
+
+
 # ------------------------------------------- RPD007 cross-module privates
 
 
@@ -746,4 +818,5 @@ RULES = (
     rule_raw_env_read,
     rule_cross_module_private,
     rule_span_collective_tag,
+    rule_dispatch_after_renew_without_fence,
 )
