@@ -75,6 +75,7 @@ DEFAULT_CONFIGS = [
     "serve129",
     "autoscale129",
     "serve_submesh129",
+    "coldstart129",
     "workloads129",
     "stats129",
     "pallasconv",
@@ -107,6 +108,7 @@ METRIC_NAMES = {
     "serve129": "2D RBC simulation service 129x129 Ra=1e7, 200 requests / 8 slots soak (drain+NaN chaos; member-steps/s + latency percentiles)",
     "autoscale129": "autoscaling fleet chaos soak 17x17 CPU (controller + launcher under Poisson notice-SIGTERM/SIGKILL preemptions; zero-lost + reclaimed-with-state + admission p99 gates)",
     "serve_submesh129": "gang-scheduled sub-mesh serving chaos soak, 2-proc CPU harness (34^2 gang-sharded + 18^2 vmapped co-resident traffic; gang-member SIGKILL mid-campaign: zero-lost + gang-reclaimed-with-state + rtol-1e-9 solo parity + co-resident latency gates)",
+    "coldstart129": "cold-start elimination 17x17 CPU (persistent compile cache + warm campaign pool + admission canonicalization: never-seen-key TTFC and restart-to-first-result cold vs warm, zero-jit warm admission, recompile-flat drain/restart/re-plan cycle, canonicalized-vs-direct parity gates)",
     "workloads129": "multi-model workloads 129x129 (dns/lnse/adjoint member-steps/s per kind + solo-vs-ensemble parity + lnse onset-sign gate)",
     "stats129": "2D RBC confined 129x129 Ra=1e7 in-scan physics stats (stats-on vs stats-off matched governed windows: bit-equal trajectory + <=5% overhead + budget-closure gates)",
     "pallasconv": "fused Pallas convection + solve megakernels vs unfused dense (RUSTPDE_CONV_KERNEL / RUSTPDE_STEP_KERNEL A/B: ms/step + MFU + bit-tolerance + HBM-traffic deltas; 129x129 min, flagship rows on-chip)",
@@ -1483,6 +1485,213 @@ def bench_serve_submesh(timeout_s=900):
         shutil.rmtree(chaos_dir, ignore_errors=True)
 
 
+def bench_coldstart(timeout_s=900):
+    """coldstart129: the cold-start elimination leg (PR 19).
+
+    Five subprocess server incarnations on the 17^2 tier shape measure
+    the three layers of README "Cold starts" end to end:
+
+    * **cold/cacheless** — RUSTPDE_COMPILE_CACHE=0, a never-seen key:
+      the baseline TTFC (campaign open -> first committed chunk) and
+      restart-to-first-result every layer is gated against,
+    * **prime** — same key with the persistent cache armed (populates
+      the shared cache dir),
+    * **warm** — a restart against the populated cache PLUS a warm
+      profile PLUS canonicalization: the off-rung request snaps into the
+      prebuilt bucket and admission -> first chunk crosses ZERO
+      compile_build rows (journal-asserted),
+    * **drain -> restart -> elastic re-plan** — one run_dir drained
+      mid-flight then resumed with a different slot count: the
+      recompile counter must stay flat across the whole cycle.
+
+    Gates: zero_jit_warm, ttfc_improved + restart_improved (warm vs
+    cold/cacheless), recompile_flat (zero recompile=true rows across
+    every leg), parity_ok (canonicalized-vs-direct Nu within the
+    documented CanonicalConfig.rtol).  Fleet mechanics, not step
+    throughput — the headline rate is member-steps over the whole
+    multi-incarnation wall."""
+    import shutil
+    import subprocess
+    import tempfile
+
+    from rustpde_mpi_tpu.config import CanonicalConfig
+    from rustpde_mpi_tpu.utils.governor import DtLadder
+    from rustpde_mpi_tpu.utils.journal import read_journal
+
+    base = tempfile.mkdtemp(prefix="bench_coldstart_")
+    cache = os.path.join(base, "jax_cache")
+    profile_path = os.path.join(base, "profile.json")
+    # the quick 17^2 compat key AFTER canonicalization: the profile dt
+    # must be the LADDER's float for the 9e-3 submit, computed from the
+    # same CanonicalConfig defaults the example's --canonicalize arms
+    canon = CanonicalConfig()
+    ladder = DtLadder(canon.dt_anchor, ratio=canon.ladder_ratio,
+                      dt_min=canon.dt_min, dt_max=canon.dt_max)
+    dt_canon = float(ladder.dt(ladder.rung_for(9e-3)))
+    with open(profile_path, "w") as fh:
+        json.dump(
+            [{"key": ["dns", 17, 17, 1e4, 1.0, dt_canon, 1.0, "rbc",
+                      False, []],
+              "k": 2}],
+            fh,
+        )
+
+    def run(name, *, cache_on, warm=False, canonicalize=False,
+            requests=1, slots=2, horizon="0.08", drain_after=None,
+            run_dir=None):
+        rd = run_dir or os.path.join(base, name)
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        env.pop("RUSTPDE_FAULT", None)
+        env["RUSTPDE_COMPILE_CACHE"] = "1" if cache_on else "0"
+        env["RUSTPDE_COMPILE_CACHE_DIR"] = cache
+        argv = [
+            sys.executable,
+            os.path.join(_REPO, "examples", "navier_rbc_serve.py"),
+            "--quick", "--requests", str(requests), "--slots", str(slots),
+            "--dt", "9e-3", "--horizon", horizon, "--run-dir", rd,
+        ]
+        if warm:
+            argv += ["--warm-profile", profile_path]
+        if canonicalize:
+            argv += ["--canonicalize"]
+        if drain_after is not None:
+            argv += ["--drain-after-s", str(drain_after)]
+        proc = subprocess.run(
+            argv, capture_output=True, text=True, timeout=timeout_s,
+            env=env, cwd=_REPO,
+        )
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"coldstart leg {name} rc={proc.returncode}: "
+                f"{proc.stderr[-1500:]}"
+            )
+        return read_journal(os.path.join(rd, "journal.jsonl"),
+                            on_error="skip"), rd
+
+    def stamp(rows, event):
+        for r in rows:
+            if r.get("event") == event:
+                return r["t"]
+        return None
+
+    def ttfc(rows):
+        a, b = stamp(rows, "campaign_start"), stamp(rows, "first_chunk")
+        return (b - a) if a is not None and b is not None else None
+
+    def first_result(rows):
+        a, b = stamp(rows, "server_start"), stamp(rows, "request_done")
+        return (b - a) if a is not None and b is not None else None
+
+    def first_nu(rd):
+        done = os.path.join(rd, "queue", "done")
+        for name in sorted(os.listdir(done)):
+            with open(os.path.join(done, name)) as fh:
+                return json.load(fh)["result"]["nu"]
+        return None
+
+    t_start = time.perf_counter()
+    try:
+        cold_rows, cold_dir = run("cold_cacheless", cache_on=False)
+        prime_rows, _ = run("prime", cache_on=True, canonicalize=True)
+        warm_rows, warm_dir = run(
+            "warm", cache_on=True, warm=True, canonicalize=True
+        )
+        # drain -> restart with a different slot count, one shared run_dir
+        cycle_dir = os.path.join(base, "cycle")
+        cyc1_rows, _ = run(
+            "cycle_drain", cache_on=True, canonicalize=True, requests=2,
+            slots=2, horizon="0.6", drain_after=4.0, run_dir=cycle_dir,
+        )
+        cyc2_rows, _ = run(
+            "cycle_replan", cache_on=True, canonicalize=True, requests=0,
+            slots=1, run_dir=cycle_dir,
+        )
+        wall = time.perf_counter() - t_start
+
+        legs = {
+            "cold": cold_rows, "prime": prime_rows, "warm": warm_rows,
+            "cycle_drain": cyc1_rows, "cycle_replan": cyc2_rows,
+        }
+        recompiles = sum(
+            1
+            for rows in legs.values()
+            for r in rows
+            if r.get("event") == "compile_build" and r.get("recompile")
+        )
+        warm_builds = [
+            r for r in warm_rows if r.get("event") == "compile_build"
+        ]
+        warm_hits = sum(
+            1 for r in warm_rows if r.get("event") == "warm_pool_hit"
+        )
+        member_steps = sum(
+            int(r.get("steps", 0))
+            for rows in legs.values()
+            for r in rows
+            if r.get("event") == "request_done"
+        )
+        ttfc_cold, ttfc_warm = ttfc(cold_rows), ttfc(warm_rows)
+        restart_cold = first_result(cold_rows)
+        restart_prime = first_result(prime_rows)
+        restart_warm = first_result(warm_rows)
+        nu_direct, nu_canon = first_nu(cold_dir), first_nu(warm_dir)
+        rtol = CanonicalConfig().rtol
+        parity = (
+            abs(nu_canon - nu_direct) / max(abs(nu_direct), 1e-12)
+            if nu_direct is not None and nu_canon is not None
+            else None
+        )
+
+        zero_jit_warm = warm_hits >= 1 and not warm_builds
+        ttfc_improved = (
+            ttfc_cold is not None and ttfc_warm is not None
+            and ttfc_warm < ttfc_cold
+        )
+        restart_improved = (
+            restart_cold is not None and restart_warm is not None
+            and restart_warm < restart_cold
+        )
+        recompile_flat = recompiles == 0
+        parity_ok = parity is not None and parity <= rtol
+        return {
+            "steps_per_sec": member_steps / max(wall, 1e-9),
+            "unit_note": (
+                "steps_per_sec = member-steps/s across all five "
+                "incarnations (17^2 CPU; mechanics, not throughput)"
+            ),
+            "ttfc_cold_s": round(ttfc_cold, 3) if ttfc_cold else None,
+            "ttfc_warm_s": round(ttfc_warm, 3) if ttfc_warm else None,
+            "restart_to_first_result_cold_s": (
+                round(restart_cold, 3) if restart_cold else None
+            ),
+            "restart_to_first_result_prime_s": (
+                round(restart_prime, 3) if restart_prime else None
+            ),
+            "restart_to_first_result_warm_s": (
+                round(restart_warm, 3) if restart_warm else None
+            ),
+            "warm_pool_hits": warm_hits,
+            "warm_leg_compile_builds": len(warm_builds),
+            "recompiles": recompiles,
+            "canonicalized_parity_rel": (
+                round(parity, 6) if parity is not None else None
+            ),
+            "parity_rtol": rtol,
+            "wall_s": round(wall, 1),
+            "zero_jit_warm": zero_jit_warm,
+            "ttfc_improved": ttfc_improved,
+            "restart_improved": restart_improved,
+            "recompile_flat": recompile_flat,
+            "parity_ok": parity_ok,
+            "finite": bool(
+                zero_jit_warm and ttfc_improved and restart_improved
+                and recompile_flat and parity_ok
+            ),
+        }
+    finally:
+        shutil.rmtree(base, ignore_errors=True)
+
+
 def bench_serve(nx=129, ny=129, ra=1e7, dt=2e-3, steps_per_req=8):
     """serve129: the simulation-service soak (rustpde_mpi_tpu/serve/).
 
@@ -1698,7 +1907,11 @@ def bench_serve(nx=129, ny=129, ra=1e7, dt=2e-3, steps_per_req=8):
                 cur = builds_by_key.setdefault(
                     tag, {"builds": 0, "wall_s_sum": 0.0}
                 )
-                cur["builds"] += 1
+                # phase-stamped rows: only the "build" phase counts a model
+                # build (the entry_points remainder row would double-count);
+                # walls sum across phases to the true cold cost
+                if row.get("phase", "build") == "build":
+                    cur["builds"] += 1
                 cur["wall_s_sum"] = round(
                     cur["wall_s_sum"] + float(row.get("wall_s", 0.0)), 4
                 )
@@ -2516,6 +2729,10 @@ def main() -> int:
                 # gang-scheduled sub-mesh serving (PR 18): mixed sharded +
                 # vmapped traffic, gang-kill chaos pair vs clean baseline
                 r = bench_serve_submesh()
+            elif name == "coldstart129":
+                # cold-start elimination (PR 19): cache/warm-pool/
+                # canonicalization legs, zero-jit warm admission gate
+                r = bench_coldstart()
             elif name == "workloads129":
                 # multi-model campaign rates (dns/lnse/adjoint) + the
                 # parity and onset-sign gates

@@ -117,8 +117,13 @@ def main() -> int:
 
     import time
 
+    from rustpde_mpi_tpu import config as _config
     from rustpde_mpi_tpu.serve.fleet import Autoscaler, LocalProcessLauncher
 
+    # arm the persistent compile cache BEFORE constructing the launcher:
+    # every replica this controller spawns inherits the cache dir through
+    # the launcher env and boots warm against serialized executables
+    _config.ensure_compile_cache()
     os.makedirs(args.run_dir, exist_ok=True)
     if args.requests:
         ids = submit_requests(args.run_dir, args.requests, args.seed,

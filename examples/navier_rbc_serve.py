@@ -32,7 +32,7 @@ import sys
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from rustpde_mpi_tpu import RequestFailed  # noqa: E402
-from rustpde_mpi_tpu.config import ServeConfig  # noqa: E402
+from rustpde_mpi_tpu.config import CanonicalConfig, ServeConfig  # noqa: E402
 from rustpde_mpi_tpu.serve import AdmissionError, SimServer  # noqa: E402
 
 
@@ -56,6 +56,13 @@ def main() -> int:
                     help="enable the HTTP front on this port (0 = ephemeral)")
     ap.add_argument("--fault", default=None,
                     help="nan@<step> | spike@<step> | kill@<step> | slow@<step>")
+    ap.add_argument("--warm-profile", default=None,
+                    help="warm campaign pool traffic profile: a JSON path, "
+                    "or 'journal' to learn it from this run_dir's history "
+                    "(see README 'Cold starts')")
+    ap.add_argument("--canonicalize", action="store_true",
+                    help="snap request dt onto the service ladder at "
+                    "admission (CanonicalConfig defaults)")
     ap.add_argument("--drain-after-s", type=float, default=None,
                     help="request a graceful drain this many seconds in "
                     "(the soak harness's deterministic SIGTERM stand-in)")
@@ -81,6 +88,8 @@ def main() -> int:
         checkpoint_every_s=args.ckpt_every_s,
         idle_exit=not args.daemon,
         http_port=args.http_port,
+        warm_profile=args.warm_profile,
+        canonicalize=CanonicalConfig() if args.canonicalize else None,
     )
     server = SimServer(cfg, fault=args.fault)
 

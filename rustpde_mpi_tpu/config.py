@@ -185,6 +185,15 @@ register_knob("RUSTPDE_SANITIZE_RING", "256",
               "sanitizer per-host ring capacity (records kept for diagnosis)")
 register_knob("RUSTPDE_SANITIZE_INJECT", None,
               "desync injection skip_broadcast@<n>[:host<p>] (tests only)")
+# persistent compile cache (cold-start elimination: serialized XLA
+# executables survive process death, so restarts / incarnations / elastic
+# re-plans reload instead of recompiling)
+register_knob("RUSTPDE_COMPILE_CACHE", "1",
+              "0 = do NOT arm the persistent JAX compilation cache in "
+              "long-lived entry points (serve/replica/resilient sessions)")
+register_knob("RUSTPDE_COMPILE_CACHE_DIR", None,
+              "persistent compile cache root (default <repo>/.jax_cache; "
+              "exported as JAX_COMPILATION_CACHE_DIR so children inherit)")
 # bench drivers (bench.py — raw reads allowed, names registered)
 register_knob("RUSTPDE_BENCH_CONFIGS", None, "comma list of bench configs", "bench")
 register_knob("RUSTPDE_BENCH_STEPS", None, "bench step-count override", "bench")
@@ -271,6 +280,50 @@ def enable_compilation_cache(path: str | None = None) -> str:
         float(os.environ["JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS"]),
     )
     return path
+
+
+_cache_armed: str | None = None
+
+
+def ensure_compile_cache() -> str | None:
+    """Idempotently arm the persistent compile cache in a long-lived entry
+    point (``SimServer.serve``, ``replica_main``, ``ResilientRunner.session``,
+    the examples drivers).  Honors the registered knobs:
+
+    * ``RUSTPDE_COMPILE_CACHE=0`` disables arming entirely (returns None —
+      byte-identical to the pre-cache behavior),
+    * ``RUSTPDE_COMPILE_CACHE_DIR`` overrides the cache root (else
+      ``JAX_COMPILATION_CACHE_DIR`` / ``<repo>/.jax_cache`` as
+      :func:`enable_compilation_cache` resolves it).
+
+    Returns the cache path when armed.  The env vars are exported, so any
+    child a launcher spawns after this call boots warm against the same
+    serialized executables."""
+    global _cache_armed
+    if env_get("RUSTPDE_COMPILE_CACHE", "1") == "0":
+        return None
+    if _cache_armed is not None:
+        return _cache_armed
+    _cache_armed = enable_compilation_cache(env_get("RUSTPDE_COMPILE_CACHE_DIR"))
+    return _cache_armed
+
+
+def compile_cache_env() -> dict:
+    """Env-var seed for spawned replicas: the cache arming vars a child needs
+    to boot against the same persistent cache (empty when the cache is off or
+    not yet armed — the child then decides for itself)."""
+    out = {}
+    for name in (
+        "JAX_COMPILATION_CACHE_DIR",
+        "JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES",
+        "JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS",
+        "RUSTPDE_COMPILE_CACHE",
+        "RUSTPDE_COMPILE_CACHE_DIR",
+    ):
+        val = os.environ.get(name)
+        if val is not None:
+            out[name] = val
+    return out
 
 
 def host_cache_dir() -> str:
@@ -635,6 +688,47 @@ class SubmeshConfig:
 
 
 @dataclass
+class CanonicalConfig:
+    """Admission canonicalization (serve/scheduler.py ``submit``): quantize
+    the request onto a small, warmable compat-key space so the warm pool's
+    AOT executables actually cover traffic.
+
+    What admission may change about a request: its ``dt`` (snapped to the
+    nearest rung of a service-wide geometric :class:`DtLadder` anchored at
+    ``dt_anchor``, only when the relative shift stays within
+    ``max_rel_dt_shift``) and the campaign slot count K (rounded UP to the
+    nearest entry of ``slot_sizes`` so a prebuilt ensemble fits — extra
+    lanes start dead and are refilled from the queue like any other slot).
+    What it may NOT change: the simulated horizon (``SimRequest.steps``
+    derives from horizon/dt, so a dt snap re-derives the step count at the
+    same physical end time), the grid/Ra/Pr/BC physics of the key, seeds,
+    priority, or deadlines.  Every snap is journaled
+    (``request_canonicalized``) and the result is guaranteed within
+    ``rtol`` of the un-canonicalized run (tests/bench gate it).
+
+    * ``dt_anchor`` / ``ladder_ratio`` — the service-wide rung grid
+      (``dt = anchor * ratio**rung``); anchor defaults to the request
+      default dt so default traffic is already on-rung,
+    * ``dt_min`` / ``dt_max`` — ladder bounds (requests outside snap to the
+      edge rung only if within ``max_rel_dt_shift``),
+    * ``max_rel_dt_shift`` — admission refuses to move dt further than
+      this relative fraction (the request then keeps its exact dt and pays
+      its own compile),
+    * ``slot_sizes`` — ascending pool sizes K is rounded up to (empty =
+      keep the configured ``ServeConfig.slots``),
+    * ``rtol`` — the documented parity tolerance between a canonicalized
+      run and the same request served at its exact dt."""
+
+    dt_anchor: float = 2e-3
+    ladder_ratio: float = 2.0
+    dt_min: float = 1e-6
+    dt_max: float = 1e-1
+    max_rel_dt_shift: float = 0.5
+    slot_sizes: tuple = ()
+    rtol: float = 5e-2
+
+
+@dataclass
 class ServeConfig:
     """Knobs for the fault-isolated simulation service
     (:class:`~rustpde_mpi_tpu.serve.SimServer`): a persistent driver that
@@ -737,6 +831,18 @@ class ServeConfig:
     # pencil-sharded flagship buckets as fate-shared GANGS on slices
     # while vmapped buckets keep the remainder.  See SubmeshConfig.
     submesh: SubmeshConfig | None = None
+    # warm campaign pool (None = off, the default: byte-identical serve
+    # behavior, zero warm-pool journal rows, CI-asserted): a traffic
+    # profile — a path to a durable JSON learned from the journal's
+    # historical compile_build rows (serve/warmpool.py learn_profile), or
+    # an inline list of {"key": [...], "k": int} entries — whose
+    # (model kind × grid × K × dt-rung) matrix is AOT-compiled in a
+    # background thread at service start and handed to the scheduler warm
+    # at bucket-open, so admission-to-first-chunk skips the jit entirely.
+    warm_profile: object | None = None
+    # admission canonicalization (None = off, the default: requests keep
+    # their exact dt and the configured slot count).  See CanonicalConfig.
+    canonicalize: CanonicalConfig | None = None
 
 
 @dataclass

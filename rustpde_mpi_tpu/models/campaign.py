@@ -131,6 +131,11 @@ class CampaignModelBase:
         # rung at most once; recompile_count tracks actual rebuilds
         self._dt_cache: dict[float, dict] = {}
         self.recompile_count = 0
+        # AOT executables (aot_compile): static-n chunk executables built
+        # ahead of traffic via .lower().compile() — dispatch prefers them,
+        # aot_reuse_count tallies dispatches served by a prebuilt executable
+        self._aot_step_n: dict[int, object] = {}
+        self.aot_reuse_count = 0
         # in-scan physics-stats engine (models/stats.py): None = off;
         # set_stats arms it — the running-sum pytree + its sample-cadence
         # tick then ride the scanned chunks, the snapshot surface and the
@@ -259,6 +264,8 @@ class CampaignModelBase:
 
         example = self._state_example()
         self.recompile_count += 1
+        self._step_n_jit = None
+        self._aot_step_n = {}
         self._sent_cc = None
         self._sent_consts = None
         self._step_n_sent = None
@@ -315,7 +322,19 @@ class CampaignModelBase:
         # fresh copy first, keeping references retained to ``self.state``
         # across the call valid (no use-after-donate on the public API).
         step_n_jit = jax.jit(step_n, static_argnames=("n",), donate_argnums=(1,))
-        self._step_n = lambda s, n: step_n_jit(self._step_consts, s, n=n)
+        # retained for aot_compile: .lower(...).compile() against these jit
+        # objects builds static-n executables ahead of traffic; a recompile
+        # pass invalidates any prebuilt executables (the consts changed)
+        self._step_n_jit = step_n_jit
+
+        def dispatch_step_n(s, n):
+            exe = self._aot_step_n.get(int(n))
+            if exe is not None:
+                self.aot_reuse_count += 1
+                return exe(self._step_consts, s)
+            return step_n_jit(self._step_consts, s, n=n)
+
+        self._step_n = dispatch_step_n
         obs_jit = jax.jit(obs_cc)
         self._obs_fn = lambda s: obs_jit(self._obs_consts, s)
 
@@ -400,6 +419,32 @@ class CampaignModelBase:
 
         self._step_n = step_n_eager
         self._obs_fn = obs_fn
+
+    def aot_compile(self, chunk_steps: int) -> int:
+        """AOT-build the chunked-step executables a ``chunk_steps``-sized
+        dispatch needs — every static scan bucket of ``run_scanned``'s
+        decomposition — via ``.lower().compile()`` on the retained jit
+        objects.  Populates the persistent compile cache (the executables
+        survive process death when it is armed) AND retains the compiled
+        objects so dispatch skips the jit machinery entirely (reuse tallied
+        in :attr:`aot_reuse_count`).  Returns how many executables were
+        newly built (0 on the eager-fallback path, where there is nothing
+        to compile ahead of time)."""
+        from ..utils.jit import scan_buckets
+
+        step_n_jit = getattr(self, "_step_n_jit", None)
+        if step_n_jit is None:
+            return 0
+        built = 0
+        with self._scope():
+            for n in scan_buckets(chunk_steps):
+                if n in self._aot_step_n:
+                    continue
+                self._aot_step_n[n] = step_n_jit.lower(
+                    self._step_consts, self.state, n=n
+                ).compile()
+                built += 1
+        return built
 
     def _compile_sentinel_entry_points(self, example) -> None:
         """Sentinel variant of the scanned chunk (set_stability): the carry

@@ -91,6 +91,11 @@ class NavierEnsemble(Integrate):
         self._pre_div_latch = False
         self._dt_cache: dict[float, dict] = {}
         self.recompile_count = 0
+        # AOT executables (aot_compile, mirrors the template model): static-n
+        # batched-chunk executables built ahead of traffic; dispatch prefers
+        # them, aot_reuse_count tallies dispatches they served
+        self._aot_step_n: dict[int, object] = {}
+        self.aot_reuse_count = 0
         # config-carried PRNG stream for respawn_dead donor perturbations
         # (reproducible recovery runs); None falls back to per-call seeds
         self.respawn_seed: int | None = None
@@ -271,6 +276,8 @@ class NavierEnsemble(Integrate):
         step_cc = model._step_cc
         obs_cc = model._obs_cc
         self.recompile_count += 1
+        self._step_n_jit = None
+        self._aot_step_n = {}
         self._step_n_sent = None
         self._step_n_stats = None
         self._stats_health_fn = None
@@ -368,9 +375,19 @@ class NavierEnsemble(Integrate):
         ens_jit = jax.jit(
             ens_step_n, static_argnames=("n",), donate_argnums=(1, 2, 3)
         )
-        self._step_n = lambda st, mk, dn, n: ens_jit(
-            model._step_consts, st, mk, dn, n=n
-        )
+        # retained for aot_compile: the warm pool lowers+compiles the
+        # batched chunk for the scheduler's static dispatch sizes ahead of
+        # traffic; dispatch prefers a prebuilt executable when one exists
+        self._step_n_jit = ens_jit
+
+        def dispatch_step_n(st, mk, dn, n):
+            exe = self._aot_step_n.get(int(n))
+            if exe is not None:
+                self.aot_reuse_count += 1
+                return exe(model._step_consts, st, mk, dn)
+            return ens_jit(model._step_consts, st, mk, dn, n=n)
+
+        self._step_n = dispatch_step_n
 
         # fused (Nu, Nuvol, Re, |div|) vmapped to shape (K,)
         obs_jit = jax.jit(jax.vmap(obs_cc, in_axes=(None, 0)))
@@ -556,6 +573,34 @@ class NavierEnsemble(Integrate):
         (the batched dot_generals in its jaxpr carry the K factor, so the
         reported ensemble MFU is per dispatch, all members included)."""
         return jax.vmap(self.model._make_step())
+
+    def aot_compile(self, chunk_steps: int) -> int:
+        """AOT-build the batched-chunk executables a ``chunk_steps``-sized
+        dispatch needs (every static scan bucket of ``run_scanned``'s
+        decomposition) via ``.lower().compile()`` — the warm pool's
+        cold-start killer: populates the persistent compile cache and
+        retains the executables so the first live dispatch reuses them
+        instead of entering jit.  Returns how many executables were newly
+        built (0 on the eager-fallback path)."""
+        from ..utils.jit import scan_buckets
+
+        step_n_jit = getattr(self, "_step_n_jit", None)
+        if step_n_jit is None:
+            return 0
+        built = 0
+        with self.model._scope():
+            for n in scan_buckets(chunk_steps):
+                if n in self._aot_step_n:
+                    continue
+                self._aot_step_n[n] = step_n_jit.lower(
+                    self.model._step_consts,
+                    self.state,
+                    self.mask,
+                    self.steps_done,
+                    n=n,
+                ).compile()
+                built += 1
+        return built
 
     # -- Integrate protocol --------------------------------------------------
 

@@ -125,6 +125,16 @@ class LocalProcessLauncher(ReplicaLauncher):
         self.env = dict(os.environ if env is None else env)
         if notice_s is not None:
             self.env["RUSTPDE_PREEMPT_NOTICE_S"] = str(float(notice_s))
+        # replicas must share the fleet's persistent compile cache: a
+        # scale-out spawn then deserializes the executables peers already
+        # built instead of recompiling them (cold-start elimination) —
+        # seed the arming vars into any custom ``env`` snapshot that lacks
+        # them (an env=None copy of os.environ already carries them when
+        # the parent armed the cache before constructing the launcher)
+        from ... import config as _config
+
+        for name, val in _config.compile_cache_env().items():
+            self.env.setdefault(name, val)
         self.log_dir = log_dir
         self.python = python or sys.executable
         self._handles: dict[str, ReplicaHandle] = {}

@@ -41,8 +41,14 @@ def main(argv: list[str] | None = None) -> int:
 
     if os.environ.get("JAX_PLATFORMS") == "cpu":
         jax.config.update("jax_platforms", "cpu")
+    from ... import config as _config
     from ...config import FleetConfig, ServeConfig
     from ..scheduler import SimServer
+
+    # arm the persistent compile cache before the first jit: a spawned
+    # (scale-out) replica inherits the fleet's cache dir from the launcher
+    # env and boots warm against the serialized executables
+    _config.ensure_compile_cache()
 
     cfg = ServeConfig(
         run_dir=args.run_dir,

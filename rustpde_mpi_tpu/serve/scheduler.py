@@ -91,6 +91,7 @@ import time
 
 import numpy as np
 
+from .. import config as _config
 from ..config import IOConfig, ServeConfig, env_get
 from ..models.ensemble import NavierEnsemble
 from ..parallel import submesh as _sm
@@ -307,6 +308,22 @@ class SimServer:
         # campaign's duration.  submesh=None leaves ALL of it inert: no
         # plan is carved, no gang row is journaled (CI-asserted).
         self._submesh = self.cfg.submesh
+        # warm campaign pool (cfg.warm_profile, serve/warmpool.py): prebuilt
+        # campaigns handed over at bucket-open; None = inert (the default)
+        self._warm = None
+        # admission canonicalization (cfg.canonicalize): the service-wide
+        # dt ladder requests are snapped onto; None = exact-dt admission
+        self._canon_ladder = None
+        if self.cfg.canonicalize is not None:
+            from ..utils.governor import DtLadder
+
+            canon = self.cfg.canonicalize
+            self._canon_ladder = DtLadder(
+                canon.dt_anchor,
+                ratio=canon.ladder_ratio,
+                dt_min=canon.dt_min,
+                dt_max=canon.dt_max,
+            )
         self._submesh_plan: _sm.SubmeshPlan | None = None
         self._submesh_meshes: dict[int, object] = {}
         self._active_mesh = None
@@ -427,6 +444,8 @@ class SimServer:
             )
         if req.amp is None:
             req.amp = float(self.cfg.default_amp)
+        if self._canon_ladder is not None:
+            self._canonicalize(req)
         if self._submesh is not None:
             # two-level serving admission: stamp the sub-mesh shape the
             # grid needs (compat_key gains the stamp, so sharded buckets
@@ -513,6 +532,59 @@ class SimServer:
             }
         )
         return req
+
+    def _canonicalize(self, req: SimRequest) -> None:
+        """Admission canonicalization (cfg.canonicalize): snap ``req.dt``
+        onto the service-wide dt ladder so the live compat-key space stays
+        small enough for the warm pool to cover traffic.  The contract
+        (README "Cold starts"): admission may move dt (within
+        ``max_rel_dt_shift``, journaled ``request_canonicalized``, result
+        within the documented rtol) but NEVER the simulated horizon —
+        ``SimRequest.steps`` derives from horizon/dt, so the step count
+        re-derives at the same physical end time — nor the physics of the
+        key, seeds, priority, or deadlines.  An off-ladder dt outside the
+        shift bound keeps its exact value and pays its own compile."""
+        canon = self.cfg.canonicalize
+        dt0 = float(req.dt)
+        try:
+            rung = self._canon_ladder.rung_for(dt0)
+            dt1 = float(self._canon_ladder.dt(rung))
+        except (ValueError, ZeroDivisionError):
+            return
+        if dt1 == dt0:
+            return
+        if abs(dt1 - dt0) / dt0 > float(canon.max_rel_dt_shift):
+            return
+        req.dt = dt1
+        _tm.counter(
+            "serve_requests_canonicalized_total",
+            "requests whose dt admission snapped onto the service ladder",
+        ).inc()
+        self._journal(
+            {
+                "event": "request_canonicalized",
+                "id": req.id,
+                "dt_from": dt0,
+                "dt_to": dt1,
+                "rung": int(rung),
+                "steps": req.steps,
+            }
+        )
+
+    def _canonical_k(self) -> int:
+        """The campaign slot count after canonicalization: ``cfg.slots``
+        rounded UP to the nearest configured pool size (extra lanes start
+        dead and refill from the queue like any other slot), so prebuilt
+        warm-pool ensembles fit live campaigns."""
+        k = int(self.cfg.slots)
+        canon = self.cfg.canonicalize
+        if canon is None or not canon.slot_sizes:
+            return k
+        sizes = sorted(int(s) for s in canon.slot_sizes)
+        for size in sizes:
+            if size >= k:
+                return size
+        return sizes[-1]
 
     def status(self, request_id: str) -> dict | None:
         """Lifecycle state + record for one request id (None: unknown)."""
@@ -748,6 +820,13 @@ class SimServer:
         root-broadcast before the collective dispatch it leads into, and
         ``sync_hosts`` fences the service open/close."""
         root = self._is_root()
+        # arm the persistent compile cache BEFORE the first model build and
+        # before the autoscaler's launcher snapshots the environment, so
+        # every restart/incarnation/elastic re-plan (and every replica this
+        # service spawns) reloads serialized executables instead of
+        # recompiling the fleet from scratch (RUSTPDE_COMPILE_CACHE=0 opts
+        # out; see config.ensure_compile_cache)
+        _config.ensure_compile_cache()
         self._install_signals()
         if root:
             self._start_http()
@@ -775,6 +854,7 @@ class SimServer:
         self._fleet_heartbeat(force=True)
         self._start_heartbeat_thread()
         self._start_autoscaler()
+        self._start_warm_pool()
         self._sync("serve-start")
         try:
             while not self._drain_agreed():
@@ -829,6 +909,7 @@ class SimServer:
                 MetricsDumper(
                     os.path.join(self._replica_dir, "metrics.jsonl")
                 ).dump(step=self._global_step)
+            self._stop_warm_pool()
             self._stop_autoscaler()
             self._stop_heartbeat_thread()
             self._fleet_heartbeat(force=True, stopping=True)
@@ -1200,6 +1281,72 @@ class SimServer:
         # the SHARED parked/<id>/ continuation dirs instead)
         return os.path.join(self._replica_dir, "campaigns", tag)
 
+    def _start_warm_pool(self) -> None:
+        """Arm the warm campaign pool (cfg.warm_profile, serve/warmpool.py):
+        resolve the traffic profile — the ``"journal"`` sentinel learns it
+        from this run_dir's historical compile_build rows, anything else
+        goes through ``warmpool.load_profile`` (durable JSON path or inline
+        list) — and start the non-blocking background build.  Gated to
+        single-process, non-submesh runtimes: a background model build on a
+        mesh would run collectives off the agreed schedule and desync
+        hosts.  ``warm_profile=None`` leaves all of it inert (no thread, no
+        journal rows — byte-identical serve, CI-asserted)."""
+        if self.cfg.warm_profile is None or self._warm is not None:
+            return
+        if self._nproc() != 1 or self._submesh is not None:
+            return
+        from . import warmpool as _wp
+
+        source = self.cfg.warm_profile
+        if isinstance(source, str) and source == "journal":
+            entries = _wp.learn_profile(self.journal_path)
+        else:
+            entries = _wp.load_profile(source)
+        if not entries:
+            return
+        self._warm = _wp.WarmPool(
+            entries, self._warm_build, journal=self._journal
+        )
+        self._warm.start()
+
+    def _stop_warm_pool(self) -> None:
+        if self._warm is not None:
+            self._warm.stop()
+
+    def _warm_build(self, key: tuple, k: int | None):
+        """Build one prebuilt campaign for the warm pool (background
+        thread): EXACTLY the ``_build_runner`` arming — registry build
+        (phase="aot" attribution), sentinels, stats, the K-member served
+        ensemble with all lanes dead — plus the AOT chunk executables
+        (``.lower().compile()`` for every static scan bucket of a
+        ``chunk_steps`` dispatch) and a prewarmed observables dispatch.
+        With sentinels/stats armed the dispatch rides their own jitted
+        variants, so the AOT executables cover the plain path only — the
+        handoff still skips the dominant model-build + entry-point cost.
+        Returns None for buckets the pool must not prebuild."""
+        key = tuple(key)
+        model = build_model_for_key(key, mesh=None, phase="aot")
+        model.write_intervall = float("inf")
+        if self.cfg.stability is not None:
+            model.set_stability(self.cfg.stability)
+        if (
+            self.cfg.stats is not None
+            and getattr(model, "MODEL_KIND", "") == "dns"
+        ):
+            model.set_stats(self.cfg.stats)
+        kk = int(k) if k else self._canonical_k()
+        ens = _ServedEnsemble(model, [model.state] * kk)
+        ens.mark_dead(range(ens.k))
+        executables = ens.aot_compile(int(self.cfg.chunk_steps))
+        try:
+            # populate the vmapped-observables dispatch cache too (the
+            # first-chunk path fetches observables right after the chunk)
+            ens.get_observables()
+        except Exception:
+            pass
+        ens._obs_cache = None
+        return model, ens, executables
+
     def _build_runner(
         self, key: tuple, k: int | None = None
     ) -> tuple[ResilientRunner, _ServedEnsemble]:
@@ -1210,26 +1357,77 @@ class SimServer:
         # dispatches are the same collective SPMD programs the runner's
         # standalone multihost runs execute.  The build seam records the
         # per-compat-key compile attribution (telemetry/compile_log.py);
-        # the journal row here is the durable copy of that observation.
+        # the journal rows here are the durable copies of that observation.
+        # A warm-pool hit skips ALL of it: the prebuilt campaign (model +
+        # ensemble + AOT chunk executables) is handed over as-is, and the
+        # only row at bucket-open is warm_pool_hit — the recompile
+        # accounting stays flat by construction.
         t_build = time.perf_counter()
-        model = build_model_for_key(key, mesh=self._campaign_mesh(key))
-        model.write_intervall = float("inf")  # no flow-file callback IO
-        if self.cfg.stability is not None:
-            # governed campaigns: arm the on-device sentinels BEFORE the
-            # ensemble vmaps its entry points (per-member CFL + pinned
-            # masks); the dt response is the scheduler's per-bucket ladder
-            # (_settle_predivergence), never a batch-wide governor
-            model.set_stability(self.cfg.stability)
-        if (
-            self.cfg.stats is not None
-            and getattr(model, "MODEL_KIND", "") == "dns"
-        ):
-            # in-scan per-member physics stats (models/stats.py): armed
-            # before the ensemble vmaps too; each done record then carries
-            # the member's health summary.  A lane refill (set_member)
-            # resets that member's averaging window — per-request stats
-            # start at claim time.
-            model.set_stats(self.cfg.stats)
+        if k is None:
+            # canonicalization's K rounding (no checkpoint pinning the
+            # size): prebuilt warm-pool ensembles then fit live campaigns
+            k = self._canonical_k()
+        k = int(k)
+        mesh = self._campaign_mesh(key)
+        warm = (
+            self._warm.take(key, k)
+            if self._warm is not None and mesh is None
+            else None
+        )
+        if warm is not None:
+            model, ens = warm
+        else:
+            model = build_model_for_key(key, mesh=mesh)
+            model.write_intervall = float("inf")  # no flow-file callback IO
+            if self.cfg.stability is not None:
+                # governed campaigns: arm the on-device sentinels BEFORE the
+                # ensemble vmaps its entry points (per-member CFL + pinned
+                # masks); the dt response is the scheduler's per-bucket ladder
+                # (_settle_predivergence), never a batch-wide governor
+                model.set_stability(self.cfg.stability)
+            if (
+                self.cfg.stats is not None
+                and getattr(model, "MODEL_KIND", "") == "dns"
+            ):
+                # in-scan per-member physics stats (models/stats.py): armed
+                # before the ensemble vmaps too; each done record then carries
+                # the member's health summary.  A lane refill (set_member)
+                # resets that member's averaging window — per-request stats
+                # start at claim time.
+                model.set_stats(self.cfg.stats)
+            ens = _ServedEnsemble(model, [model.state] * k)
+            ens.mark_dead(range(ens.k))  # all lanes idle until request lands
+            # two phase-stamped compile_build rows cover the campaign build
+            # window: "build" is the registry seam's model construction,
+            # "entry_points" the campaign-level remainder (armed sentinels +
+            # the K-member ensemble trace) — they SUM to the serving path's
+            # real cold cost, so TTFC attribution adds up instead of ~2x
+            builds = _cl.build_counts().get(_cl.key_tag(key), 1)
+            wall_total = time.perf_counter() - t_build
+            wall_build = min(_cl.last_build_wall(key), wall_total)
+            base = {
+                "event": "compile_build",
+                "key": list(key),
+                "key_tag": _cl.key_tag(key),
+                "builds": builds,
+                "k": ens.k,
+            }
+            self._journal(
+                {
+                    **base,
+                    "phase": "build",
+                    "wall_s": round(wall_build, 4),
+                    "recompile": builds > 1,
+                }
+            )
+            self._journal(
+                {
+                    **base,
+                    "phase": "entry_points",
+                    "wall_s": round(max(0.0, wall_total - wall_build), 4),
+                    "recompile": False,
+                }
+            )
         # per-member step flops for the live MFU gauge: the trace-only jaxpr
         # dot count (no extra compile; the entry points were just built)
         try:
@@ -1238,24 +1436,6 @@ class SimServer:
             self._flops_member = step_flops(model, method="jaxpr")
         except Exception:
             self._flops_member = None
-        k = int(self.cfg.slots if k is None else k)
-        ens = _ServedEnsemble(model, [model.state] * k)
-        ens.mark_dead(range(ens.k))  # all lanes idle until a request lands
-        # the compile_build journal row covers the WHOLE campaign build
-        # window — base model (registry seam), armed sentinels and the
-        # K-member ensemble trace — the serving path's real cold cost, not
-        # just the single-model constructor
-        builds = _cl.build_counts().get(_cl.key_tag(key), 1)
-        self._journal(
-            {
-                "event": "compile_build",
-                "key": list(key),
-                "key_tag": _cl.key_tag(key),
-                "wall_s": round(time.perf_counter() - t_build, 4),
-                "builds": builds,
-                "recompile": builds > 1,
-            }
-        )
         rcfg = self.cfg.resilience
         runner = ResilientRunner.from_config(
             ens,

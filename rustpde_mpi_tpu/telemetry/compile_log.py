@@ -41,6 +41,8 @@ from .. import config as _config
 from . import metrics as _tm
 
 _builds: dict[str, int] = {}  # compat-key tag -> in-process build count
+_last_walls: dict[str, float] = {}  # compat-key tag -> last build wall (phase="build")
+_warm_pool: dict[str, int] = {"hit": 0, "miss": 0, "evict": 0, "aot": 0}
 _lock = threading.Lock()
 
 
@@ -53,19 +55,32 @@ def key_tag(key) -> str:
     return hashlib.sha1(repr(tuple(key)).encode()).hexdigest()[:12]
 
 
-def observe_build(key, wall_s: float, kind: str = "") -> dict:
+def observe_build(key, wall_s: float, kind: str = "", phase: str = "build") -> dict:
     """Record one model build for a compat key; returns the journal-ready
-    payload (the caller owns the journal, root-ness and all)."""
+    payload (the caller owns the journal, root-ness and all).
+
+    ``phase`` disambiguates the layered observers around one campaign open —
+    ``build`` (the registry's model construction, the only phase that bumps
+    the per-key build/recompile accounting), ``entry_points`` (the
+    scheduler's campaign-level remainder: ensemble wrap + arming, journaled
+    so TTFC attribution SUMS across rows instead of double-counting the
+    build wall ~2x), and ``aot`` (warm-pool ahead-of-time builds)."""
     tag = key_tag(key)
-    with _lock:
-        _builds[tag] = _builds.get(tag, 0) + 1
-        count = _builds[tag]
+    if phase == "build":
+        with _lock:
+            _builds[tag] = _builds.get(tag, 0) + 1
+            count = _builds[tag]
+            _last_walls[tag] = wall_s
+    else:
+        with _lock:
+            count = _builds.get(tag, 1)
     _tm.histogram(
         "compile_build_seconds",
         "model build + jit wall per compat key",
         key=tag,
+        phase=phase,
     ).observe(wall_s)
-    if count > 1:
+    if phase == "build" and count > 1:
         _tm.counter(
             "compile_recompiles_total",
             "model rebuilds of an already-built compat key",
@@ -75,9 +90,10 @@ def observe_build(key, wall_s: float, kind: str = "") -> dict:
         "event": "compile_build",
         "key_tag": tag,
         "kind": kind,
+        "phase": phase,
         "wall_s": round(wall_s, 4),
         "builds": count,
-        "recompile": count > 1,
+        "recompile": phase == "build" and count > 1,
     }
 
 
@@ -85,6 +101,49 @@ def build_counts() -> dict:
     """Per-key in-process build counts (tests + the bench payload)."""
     with _lock:
         return dict(_builds)
+
+
+def last_build_wall(key) -> float:
+    """The most recent phase="build" wall for a compat key (0.0 when the
+    key never built in this process) — the scheduler subtracts it from its
+    campaign-open window so the ``entry_points`` row carries only the
+    remainder and the per-key rows sum to the true TTFC."""
+    with _lock:
+        return _last_walls.get(key_tag(key), 0.0)
+
+
+def observe_warm_pool(event: str, key=None, k: int | None = None, **extra) -> dict:
+    """Warm-pool accounting (serve/warmpool.py): ``event`` is one of
+    ``hit`` / ``miss`` / ``evict`` / ``aot``; returns the journal-ready
+    payload.  Counters ride the shared metrics registry so the bench and
+    the hit-rate gates read one source of truth."""
+    with _lock:
+        _warm_pool[event] = _warm_pool.get(event, 0) + 1
+    _tm.counter(
+        "serve_warm_pool_events_total",
+        "warm campaign pool events (hit/miss/evict/aot)",
+        event=event,
+    ).inc()
+    payload = {
+        "event": {
+            "hit": "warm_pool_hit",
+            "miss": "warm_pool_miss",
+            "evict": "warm_pool_evict",
+            "aot": "aot_build",
+        }.get(event, f"warm_pool_{event}"),
+    }
+    if key is not None:
+        payload["key_tag"] = key_tag(key)
+    if k is not None:
+        payload["k"] = int(k)
+    payload.update(extra)
+    return payload
+
+
+def warm_pool_counts() -> dict:
+    """Warm-pool event counts (tests + the bench payload), a copy."""
+    with _lock:
+        return dict(_warm_pool)
 
 
 def observe_entry_compile(model_kind: str, wall_s: float) -> None:

@@ -34,6 +34,17 @@ def run_scanned(step_n, state, n: int):
     program variants sharing the step math (the plain and sentinel-armed
     chunks, models/navier.py) stay BIT-identical whenever their schedules
     agree."""
+    for bucket in scan_buckets(n):
+        state = step_n(state, bucket)
+    return state
+
+
+def scan_buckets(n: int) -> list:
+    """The static bucket schedule :func:`run_scanned` dispatches for ``n``
+    steps (in order).  Exposed so the warm pool can AOT-compile exactly the
+    executables a ``chunk_steps``-sized dispatch will need — one source of
+    truth for the decomposition."""
+    out = []
     remaining = int(n)
     while remaining > 0:
         if remaining == 3:
@@ -42,9 +53,9 @@ def run_scanned(step_n, state, n: int):
             bucket = 1 << (remaining.bit_length() - 1)
             if bucket > 1 and remaining - bucket == 1:
                 bucket //= 2  # leave a 3-tail instead of a 1-tail
-        state = step_n(state, bucket)
+        out.append(bucket)
         remaining -= bucket
-    return state
+    return out
 
 
 def hoist_constants(fn, *example):

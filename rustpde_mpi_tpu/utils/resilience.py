@@ -79,6 +79,7 @@ from .faults import FaultPlan, FaultSpecError, validate_fault_env  # noqa: F401
 from .governor import StabilityGovernor
 from .integrate import integrate
 
+from .. import config
 from ..config import env_get
 from ..parallel import sanitizer as _sanitizer
 from .io_pipeline import IOPipeline
@@ -1440,6 +1441,10 @@ class ResilientRunner:
         and restores signal handlers — including on the
         :class:`DispatchHang` path, where lagged diagnostics are abandoned
         rather than resolved against a wedged device."""
+        # long-lived entry point: arm the persistent compile cache so a
+        # restarted incarnation deserializes its executables instead of
+        # recompiling (RUSTPDE_COMPILE_CACHE=0 opts out; idempotent)
+        config.ensure_compile_cache()
         self.resumed = False
         if install_signals:
             self._install_signals()

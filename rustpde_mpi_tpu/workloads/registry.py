@@ -63,7 +63,7 @@ def build_model(
     )
 
 
-def build_model_for_key(key: tuple, *, mesh=None):
+def build_model_for_key(key: tuple, *, mesh=None, phase: str = "build"):
     """Build the campaign model one compat-key bucket needs (the serve
     scheduler's campaign constructor): ``key`` is the 10-tuple
     ``(kind, nx, ny, ra, pr, dt, aspect, bc, periodic, scenario_sig)``,
@@ -74,7 +74,9 @@ def build_model_for_key(key: tuple, *, mesh=None):
     This is THE model-build/jit seam for every bucket, so compile
     attribution hangs here: build wall time and the recompile count are
     recorded per compat key (telemetry/compile_log.py) — the cold-start
-    ROADMAP item's baseline numbers."""
+    ROADMAP item's baseline numbers.  ``phase`` stamps the attribution row
+    ("build" for live campaign opens, "aot" when the warm pool builds
+    ahead of traffic)."""
     import time as _time
 
     from ..telemetry import compile_log
@@ -104,7 +106,9 @@ def build_model_for_key(key: tuple, *, mesh=None):
             f"registry builder for {kind!r} produced compat_key "
             f"{model.compat_key} for requested key {tuple(key)}"
         )
-    compile_log.observe_build(key, _time.perf_counter() - t0, kind=str(kind))
+    compile_log.observe_build(
+        key, _time.perf_counter() - t0, kind=str(kind), phase=phase
+    )
     return model
 
 

@@ -123,6 +123,11 @@ def main() -> int:
         # latency gates) — two-level serving gets the same tracked record
         # the flat multihost scheduler has
         "gang_serve": _gang_serve_counters(),
+        # cold-start elimination counters from the coldstart129 legs
+        # (cache/warm-pool/canonicalization TTFC + restart walls and
+        # their gates) — the serving stack's p99-compile story gets the
+        # same tracked record its chaos legs have
+        "coldstart": _coldstart_counters(),
         # per-model solo-vs-ensemble parity deltas (workloads satellite):
         # recorded into PARITY.json too, so cross-model vmap/scan drift
         # shows up per-PR next to the Nu-parity numbers
@@ -326,6 +331,42 @@ def _autoscale_counters() -> dict | None:
                 "zero_lost",
                 "reclaimed_with_state",
                 "slo_ok",
+                "error",
+            )
+            if key in row
+        }
+    except (OSError, ValueError, KeyError):
+        return None
+
+
+def _coldstart_counters() -> dict | None:
+    """Cold-start counters from BENCH_FULL.json's ``coldstart129`` row
+    (persistent compile cache + warm campaign pool + admission
+    canonicalization legs): never-seen-key TTFC and restart-to-first-
+    result cold vs warm, the zero-jit warm admission / recompile-flat /
+    canonicalization-parity gates.  None when the config was never
+    benched — or predates the warm pool."""
+    try:
+        with open(os.path.join(_REPO, "BENCH_FULL.json")) as f:
+            row = json.load(f)["results"]["coldstart129"]
+        return {
+            key: row.get(key)
+            for key in (
+                "ttfc_cold_s",
+                "ttfc_warm_s",
+                "restart_to_first_result_cold_s",
+                "restart_to_first_result_prime_s",
+                "restart_to_first_result_warm_s",
+                "warm_pool_hits",
+                "warm_leg_compile_builds",
+                "recompiles",
+                "canonicalized_parity_rel",
+                "parity_rtol",
+                "zero_jit_warm",
+                "ttfc_improved",
+                "restart_improved",
+                "recompile_flat",
+                "parity_ok",
                 "error",
             )
             if key in row

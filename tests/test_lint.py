@@ -405,6 +405,80 @@ def boundary(self, runner, n):
     )
 
 
+# -- RPD010: compile construction on the per-boundary hot path ----------------
+
+
+def test_rpd010_jit_in_boundary_method_flagged():
+    # the cold-start regression shape PR 19 exists to kill: a trace at a
+    # chunk boundary stalls a LIVE campaign for seconds
+    src = '''
+def _settle_boundary(self, runner, ens, slots, key):
+    step = jax.jit(ens.step_fn, donate_argnums=(0,))
+    runner.dispatch(step)
+'''
+    found = lint_source(src, "rustpde_mpi_tpu/serve/scheduler.py")
+    assert "RPD010" in rules_of(found)
+    (f,) = [f for f in found if f.rule == "RPD010"]
+    assert "_build_runner" in f.message
+
+
+def test_rpd010_model_build_in_fill_slots_flagged():
+    src = '''
+def _fill_slots(self, runner, ens, slots, key):
+    model = build_model_for_key(key, mesh=None)
+    ens.set_member(0, model.state)
+'''
+    found = lint_source(src, "rustpde_mpi_tpu/serve/scheduler.py")
+    assert "RPD010" in rules_of(found)
+
+
+def test_rpd010_aot_lower_in_campaign_loop_flagged():
+    src = '''
+def _campaign_loop(self, runner, ens, slots, key):
+    exe = self._step_n_jit.lower(consts, state, n=8).compile()
+    exe(consts, state)
+'''
+    found = lint_source(src, "rustpde_mpi_tpu/serve/scheduler.py")
+    assert "RPD010" in rules_of(found)
+
+
+def test_rpd010_str_lower_passes_clean():
+    # argument-less .lower() is str.lower, not an AOT lowering
+    src = '''
+def _flush_results(self, force=False):
+    tag = self._state.name.lower()
+    self._emit(tag)
+'''
+    assert "RPD010" not in rules_of(
+        lint_source(src, "rustpde_mpi_tpu/serve/scheduler.py")
+    )
+
+
+def test_rpd010_build_runner_is_out_of_region():
+    # campaign OPEN is where builds belong — the rule only polices the
+    # per-boundary methods
+    src = '''
+def _build_runner(self, key, k=None):
+    model = build_model_for_key(key, mesh=self._campaign_mesh(key))
+    step = jax.jit(model.step, static_argnames=("n",))
+    return model, step
+'''
+    assert "RPD010" not in rules_of(
+        lint_source(src, "rustpde_mpi_tpu/serve/scheduler.py")
+    )
+
+
+def test_rpd010_out_of_scope_module_not_flagged():
+    src = '''
+def _campaign_loop(self):
+    fn = jax.jit(self.step)
+    return fn
+'''
+    assert "RPD010" not in rules_of(
+        lint_source(src, "rustpde_mpi_tpu/models/campaign.py")
+    )
+
+
 # -- generic layer ------------------------------------------------------------
 
 

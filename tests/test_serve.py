@@ -1149,17 +1149,31 @@ def test_http_trace_and_profile_endpoints(tmp_path, monkeypatch):
 
 
 def test_compile_attribution_rides_serve_journal(tmp_path):
-    """Every campaign build journals a compile_build row (key-tagged, wall
-    time, recompile flag) and the first committed chunk a first_chunk row
-    — the cold-start item's baseline numbers, durably recorded."""
+    """Every campaign build journals phase-stamped compile_build rows
+    (key-tagged, wall time, recompile flag): one "build" row for the
+    registry's model construction plus one "entry_points" row for the
+    campaign-level remainder — summing to the bucket's true cold cost —
+    and the first committed chunk a first_chunk row — the cold-start
+    item's baseline numbers, durably recorded."""
     srv = SimServer(_cfg(tmp_path, slots=2))
-    srv.submit(dict(_REQ, seed=0))
-    srv.submit(dict(_REQ, dt=0.005, seed=1))  # second bucket: second build
+    # unique ra => compat keys no other test in this process has built,
+    # so the recompile=False assertion holds under any suite ordering
+    # (the build counter is process-global by design)
+    srv.submit(dict(_REQ, ra=1.2e4, seed=0))
+    srv.submit(dict(_REQ, ra=1.2e4, dt=0.005, seed=1))  # second bucket
     assert srv.serve()["completed"] == 2
     events = _events(srv.cfg.run_dir)
     builds = [e for e in events if e["event"] == "compile_build"]
-    assert len(builds) == 2
-    assert all(e["wall_s"] > 0 and len(e["key_tag"]) == 12 for e in builds)
+    assert len(builds) == 4
+    assert all(len(e["key_tag"]) == 12 for e in builds)
+    by_phase = {"build": [], "entry_points": []}
+    for e in builds:
+        by_phase[e["phase"]].append(e)
+    assert len(by_phase["build"]) == 2 and len(by_phase["entry_points"]) == 2
+    assert all(e["wall_s"] > 0 for e in by_phase["build"])
+    assert all(e["wall_s"] >= 0 for e in by_phase["entry_points"])
+    # no phase recompiles on first builds, and the rows carry the campaign k
+    assert all(e["recompile"] is False and e["k"] == 2 for e in builds)
     firsts = [e for e in events if e["event"] == "first_chunk"]
     assert len(firsts) == 2
     assert all(e["wall_s"] > 0 for e in firsts)

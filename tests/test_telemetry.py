@@ -498,6 +498,7 @@ def test_compile_log_build_attribution_and_recompile_count():
     assert len(tag) == 12
     first = compile_log.observe_build(key, 0.5, kind="dns")
     assert first["recompile"] is False and first["builds"] >= 1
+    assert first["phase"] == "build"
     again = compile_log.observe_build(key, 0.25, kind="dns")
     assert again["recompile"] is True and again["builds"] == first["builds"] + 1
     snap = telemetry.snapshot()
@@ -505,8 +506,14 @@ def test_compile_log_build_attribution_and_recompile_count():
         tuple(sorted(s["labels"].items())): s
         for s in snap["compile_build_seconds"]["series"]
     }
-    assert (("key", tag),) in series
-    assert series[(("key", tag),)]["count"] >= 2
+    assert (("key", tag), ("phase", "build")) in series
+    assert series[(("key", tag), ("phase", "build"))]["count"] >= 2
+    # a non-build phase rides its own series and does NOT bump the per-key
+    # build count (TTFC attribution sums across phases instead of ~2x)
+    entry = compile_log.observe_build(key, 0.1, kind="dns", phase="entry_points")
+    assert entry["phase"] == "entry_points" and entry["recompile"] is False
+    assert compile_log.build_counts()[tag] == again["builds"]
+    assert compile_log.last_build_wall(key) == 0.25
     recomp = {
         s["labels"]["key"]: s["value"]
         for s in snap["compile_recompiles_total"]["series"]

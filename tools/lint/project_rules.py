@@ -32,6 +32,13 @@ caught (README "Static analysis & sanitizer" has the rule -> bug table):
   checks, randomness).  Instrumentation args that differ per host around
   a collective are the desync-by-instrumentation shape the runtime
   sanitizer can only catch once it has already happened.
+* RPD010 — compile construction (``jax.jit``, ``pallas_call``, an AOT
+  ``.lower(...)``, ``build_model_for_key``, ``_compile_entry_points``)
+  reachable from a per-boundary scheduler method (PR 19: cold-start
+  elimination only holds if nothing on the chunk-boundary hot path can
+  trigger a trace — a jit construction there is a multi-second stall
+  inside the serve loop).  Builds belong in ``_build_runner`` /
+  ``_warm_build`` / the warm-pool background thread.
 * RPD009 — a collective/dispatch call issued after a lease renewal with
   no fencing check between them (PR 18 review, the gang-scheduling
   shape): ``renew()`` raising ``LeaseLost`` marks the replica FENCED,
@@ -741,6 +748,75 @@ def rule_dispatch_after_renew_without_fence(module) -> list:
     return out
 
 
+# ---------------------------------- RPD010 compile construction per boundary
+
+#: scheduler methods that run at EVERY chunk boundary of a live campaign —
+#: the latency-critical region cold-start work must never leak into
+PER_BOUNDARY_METHODS = {
+    "_campaign_loop",
+    "_settle_boundary",
+    "_fill_slots",
+    "_settle_predivergence",
+    "_maybe_preempt",
+    "_handle_death",
+    "_flush_results",
+    "_refresh_slot_state",
+    "_fence_check",
+    "_boundary_gauges",
+}
+
+#: calls that construct (or force) an XLA compile when they execute
+COMPILE_CONSTRUCTION_CALLS = {
+    "jit",
+    "pallas_call",
+    "build_model_for_key",
+    "aot_compile",
+    "compile_entry_points",
+    "_compile_entry_points",
+    "_compile_entry_points_impl",
+}
+
+
+def rule_compile_in_boundary_path(module) -> list:
+    """RPD010: no compile construction on the per-boundary hot path.
+
+    The warm pool / AOT machinery (PR 19) moves every trace+compile to
+    campaign OPEN (``_build_runner``) or the background warm-pool builder
+    (``_warm_build``); a ``jax.jit``/``pallas_call``/``.lower()`` call
+    that executes inside a per-boundary method re-introduces the
+    multi-second stall in the middle of a live campaign, where it also
+    skews the boundary budget the governor steers by.  ``.lower`` is only
+    flagged when called WITH arguments (a jit AOT lowering takes the
+    concrete args; an argument-less ``.lower()`` is ``str.lower``)."""
+    if not module.relpath.startswith("rustpde_mpi_tpu/serve/"):
+        return []
+    out = []
+    for qualname, fn in _functions(module.tree):
+        if fn.name not in PER_BOUNDARY_METHODS:
+            continue
+        for n in ast.walk(fn):
+            if not isinstance(n, ast.Call):
+                continue
+            name = _call_name(n)
+            if name not in COMPILE_CONSTRUCTION_CALLS and not (
+                name == "lower" and (n.args or n.keywords)
+            ):
+                continue
+            out.append(
+                module.finding(
+                    "RPD010",
+                    n,
+                    f"compile construction '{name}' inside per-boundary "
+                    f"method '{fn.name}' — a trace/compile here stalls a "
+                    "LIVE campaign for seconds at a chunk boundary; move "
+                    "the build to _build_runner/_warm_build (campaign "
+                    "open or the warm-pool background thread)",
+                    qualname,
+                )
+            )
+    return out
+
+
 # ------------------------------------------- RPD007 cross-module privates
 
 
@@ -819,4 +895,5 @@ RULES = (
     rule_cross_module_private,
     rule_span_collective_tag,
     rule_dispatch_after_renew_without_fence,
+    rule_compile_in_boundary_path,
 )
