@@ -78,6 +78,7 @@ DEFAULT_CONFIGS = [
     "coldstart129",
     "workloads129",
     "stats129",
+    "integrity129",
     "pallasconv",
     "bandedsolve",
     "periodic",
@@ -111,6 +112,7 @@ METRIC_NAMES = {
     "coldstart129": "cold-start elimination 17x17 CPU (persistent compile cache + warm campaign pool + admission canonicalization: never-seen-key TTFC and restart-to-first-result cold vs warm, zero-jit warm admission, recompile-flat drain/restart/re-plan cycle, canonicalized-vs-direct parity gates)",
     "workloads129": "multi-model workloads 129x129 (dns/lnse/adjoint member-steps/s per kind + solo-vs-ensemble parity + lnse onset-sign gate)",
     "stats129": "2D RBC confined 129x129 Ra=1e7 in-scan physics stats (stats-on vs stats-off matched governed windows: bit-equal trajectory + <=5% overhead + budget-closure gates)",
+    "integrity129": "2D RBC confined 129x129 Ra=1e7 SDC defense (digests-on vs off matched windows: bit-equal trajectory + <=2% digest-stream overhead + injected-bitflip caught/rolled-back/bit-equal gates)",
     "pallasconv": "fused Pallas convection + solve megakernels vs unfused dense (RUSTPDE_CONV_KERNEL / RUSTPDE_STEP_KERNEL A/B: ms/step + MFU + bit-tolerance + HBM-traffic deltas; 129x129 min, flagship rows on-chip)",
     "bandedsolve": "lane-parallel Pallas banded substitution vs dense-inverse GEMM vs lax.scan recurrence (ops/pallas_banded.bench_banded_paths: sec/solve per path at 1023x1025)",
     "periodic": "2D RBC periodic 128x65 Ra=1e6",
@@ -718,6 +720,149 @@ def bench_stats(nx, ny, ra, dt, steps):
         "budget_ok": budget_ok,
         "steps": window,
         "finite": bool(bit_equal and overhead_ok and budget_ok),
+    }
+
+
+def bench_integrity(nx, ny, ra, dt, steps):
+    """SDC-defense config (integrity/, ISSUE 20): digests-on vs digests-off
+    through the governed runner advance path, matched windows interleaved
+    rep by rep, min-of-reps — the stats129 protocol.  The overhead legs run
+    at a huge audit cadence so they price the DIGEST STREAMING alone (the
+    always-on cost: one bitcast-XOR/add tree reduction fused per chunk,
+    result streamed with the observables future); the shadow re-execution
+    audit re-steps a chunk on the side at its sampled cadence, so its cost
+    is the chunk work divided by the cadence — a policy knob, not a tax,
+    and it is gated by the detection leg instead.
+
+    Gates (all fold into ``finite``):
+
+    * ``integrity_bit_equal`` — digests only READ the state: the committed
+      trajectory with auditing armed is EXACTLY equal (float equality) to
+      the unaudited run,
+    * ``integrity_overhead_ok`` — digest-streaming wall overhead ≤2%,
+    * ``sdc_caught`` — an injected single-bit mantissa flip mid-run is
+      detected by the shadow audit (``integrity_mismatch`` journaled),
+      rolled back (``integrity_rollback``), and the completed run's final
+      state is BIT-EQUAL to an uninjected run's — corruption fully erased,
+      not merely noticed."""
+    import shutil
+    import tempfile
+
+    import jax as _jax
+    import numpy as np
+
+    from rustpde_mpi_tpu import Navier2D, ResilientRunner, config
+    from rustpde_mpi_tpu.config import IntegrityConfig, IOConfig
+    from rustpde_mpi_tpu.utils.journal import read_journal
+
+    config.enable_compilation_cache()
+
+    def build(integrity=False, cadence=None):
+        model = Navier2D(nx, ny, ra, 1.0, dt, 1.0, "rbc", periodic=False)
+        model.set_velocity(0.1, 2.0, 2.0)
+        model.set_temperature(0.1, 2.0, 2.0)
+        model.write_intervall = 1e9
+        if integrity:
+            model.set_integrity(IntegrityConfig(cadence=cadence))
+        return model
+
+    L = max(16, int(steps))
+    window = 8 * L  # 8 chunk boundaries per timed window (digest cadence real)
+    reps = 7
+    dirs = [tempfile.mkdtemp(prefix="bench_integrity_") for _ in range(5)]
+    try:
+        runners = {}
+        for key, d in (("on", dirs[0]), ("off", dirs[1])):
+            # cadence 10**9: chain digests stream at every boundary, the
+            # shadow audit never fires — the always-on cost in isolation
+            runners[key] = ResilientRunner(
+                build(integrity=key == "on", cadence=10**9),
+                max_time=float("inf"),
+                run_dir=d,
+                checkpoint_every_s=None,
+                max_chunk_steps=L,
+            )
+        walls = {"on": [], "off": []}
+        for key, r in runners.items():  # compile + warm the chunk shapes
+            r.advance(window)
+            _jax.block_until_ready(r.pde.state)
+        for _ in range(reps):
+            for key, r in runners.items():
+                t0 = time.perf_counter()
+                r.advance(window)
+                _jax.block_until_ready(r.pde.state)
+                walls[key].append(time.perf_counter() - t0)
+        overhead = min(walls["on"]) / min(walls["off"]) - 1.0
+        bit_equal = all(
+            bool(
+                np.array_equal(
+                    np.asarray(getattr(runners["on"].pde.state, name)),
+                    np.asarray(getattr(runners["off"].pde.state, name)),
+                )
+            )
+            for name in runners["off"].pde.state._fields
+        )
+
+        # detection leg: clean vs injected, both fully audited (cadence 1),
+        # short fixed horizon — the flip lands mid-run, the shadow audit
+        # catches it at the chunk commit, rollback replays from the last
+        # verified state, and the answers must agree to the BIT
+        horizon, chunk = 40 * dt, 8
+        det = {}
+        for key, d, fault in (
+            ("clean", dirs[2], None),
+            ("hit", dirs[3], f"bitflip@{2 * chunk}"),
+        ):
+            r = ResilientRunner(
+                build(integrity=True, cadence=1),
+                max_time=horizon,
+                run_dir=d,
+                checkpoint_every_s=None,
+                max_chunk_steps=chunk,
+                fault=fault,
+                io=IOConfig(async_checkpoints=False, overlap_dispatch=False),
+            )
+            r.run()
+            det[key] = r.pde
+        hit_events = [
+            e.get("event")
+            for e in read_journal(
+                os.path.join(dirs[3], "journal.jsonl"), on_error="skip"
+            )
+        ]
+        sdc_bit_equal = all(
+            bool(
+                np.array_equal(
+                    np.asarray(getattr(det["clean"].state, name)),
+                    np.asarray(getattr(det["hit"].state, name)),
+                )
+            )
+            for name in det["clean"].state._fields
+        )
+        sdc_caught = bool(
+            "bitflip_injected" in hit_events
+            and "integrity_mismatch" in hit_events
+            and "integrity_rollback" in hit_events
+            and sdc_bit_equal
+        )
+    finally:
+        for d in dirs:
+            shutil.rmtree(d, ignore_errors=True)
+
+    overhead_ok = bool(overhead <= 0.02)
+    steps_total = reps * window
+    return {
+        "steps_per_sec": steps_total / sum(walls["on"]) if walls["on"] else 0.0,
+        "plain_steps_per_sec": (
+            steps_total / sum(walls["off"]) if walls["off"] else 0.0
+        ),
+        "integrity_overhead_x": 1.0 + overhead,
+        "integrity_overhead_ok": overhead_ok,
+        "integrity_bit_equal": bit_equal,
+        "sdc_caught": sdc_caught,
+        "sdc_bit_equal": sdc_bit_equal,
+        "steps": window,
+        "finite": bool(bit_equal and overhead_ok and sdc_caught),
     }
 
 
@@ -2749,6 +2894,10 @@ def main() -> int:
                 # matched governed windows, stats-on vs stats-off; the
                 # window is capped so the doubled run fits the budget
                 r = bench_stats(129, 129, 1e7, 2e-3, max(32, min(steps, 64)))
+            elif name == "integrity129":
+                # digests-on vs off matched windows + the injected-bitflip
+                # detection pair; capped like stats129 (four runs total)
+                r = bench_integrity(129, 129, 1e7, 2e-3, max(32, min(steps, 64)))
             elif name == "governor129":
                 # overhead leg slope-times two chains; the spike legs rerun
                 # a capped horizon (governed: at the descended-ladder dt)

@@ -127,6 +127,17 @@ register_knob("RUSTPDE_STATS_TAIL_WARN", "1e-3",
               "spectral-tail energy fraction above which resolution_warning fires")
 register_knob("RUSTPDE_STATS_BUDGET_WARN", "0.5",
               "Nu budget-closure residual above which budget_drift fires")
+# end-to-end integrity (integrity/: on-device state digests, shadow
+# re-execution audits, device quarantine)
+register_knob("RUSTPDE_INTEGRITY", None,
+              "1 = arm on-device state digests + shadow re-execution audits "
+              "on from_config DNS models")
+register_knob("RUSTPDE_INTEGRITY_CADENCE", "8",
+              "committed chunks between shadow re-execution audits (digests "
+              "stream every chunk; 0 = digests only, never audit)")
+register_knob("RUSTPDE_VOTE_RATE", "0",
+              "fleet proxy cross-replica voting: fraction of requests "
+              "double-assigned and digest-compared at completion (0..1)")
 # telemetry
 register_knob("RUSTPDE_TELEMETRY", "1", "telemetry master switch")
 register_knob("RUSTPDE_TRACE", "1", "flight-recorder span tracing switch")
@@ -147,8 +158,8 @@ register_knob("RUSTPDE_SYNC_TIMEOUT_S", "0",
               "barrier/broadcast watchdog (0 = off): peer death -> DispatchHang")
 register_knob("RUSTPDE_IO_TIMEOUT_S", None, "async checkpoint writer watchdog")
 register_knob("RUSTPDE_FAULT", None,
-              "fault injection <nan|spike|kill|slow>@<step>"
-              "[:host<p>|:gang<g>[member<m>]]")
+              "fault injection <nan|spike|kill|slow|bitflip>@<step>"
+              "[:host<p>|:member<k>|:gang<g>[member<m>]]")
 register_knob("RUSTPDE_GANG_SYNC_TIMEOUT_S", "0",
               "gang-barrier watchdog (0 = off): a dead gang member trips "
               "this deadline and surfaces as typed GangMemberLost instead "
@@ -444,6 +455,40 @@ class StatsConfig:
     stride: int | None = None
     tail_warn: float | None = None
     budget_warn: float | None = None
+
+
+@dataclass
+class IntegrityConfig:
+    """Knobs for the end-to-end integrity layer (``integrity/``, armed via
+    a DNS model's ``set_integrity``): an on-device state digest (bitcast
+    XOR/add fold, see :func:`~rustpde_mpi_tpu.integrity.digest_tree`)
+    streamed with the observables futures after every committed chunk, plus
+    sampled shadow re-execution audits in the resilient runner.
+
+    * ``cadence`` — committed chunks between audits (None:
+      ``RUSTPDE_INTEGRITY_CADENCE``, default 8; 0 = stream digests but
+      never audit).  An audit replays the just-completed chunk from the
+      retained chunk-start copy and compares digests — deterministic XLA
+      means bit-equal or corrupted,
+    * ``strikes`` — audit mismatches charged to one device before the
+      quarantine ledger journals ``device_quarantined`` and the serve
+      scheduler re-carves sub-meshes around it,
+    * ``strike_ttl_s`` — ledger strike expiry window: strikes older than
+      this no longer count toward the threshold (transient upsets decay,
+      sticky-bad silicon accumulates).
+
+    The hard contract (bench-gated like the stats engine): the digest READS
+    the state and never feeds back — the trajectory is bit-identical
+    integrity-on vs integrity-off, overhead ≤2%."""
+
+    cadence: int | None = None
+    strikes: int = 2
+    strike_ttl_s: float = 3600.0
+
+    def resolved_cadence(self) -> int:
+        if self.cadence is not None:
+            return int(self.cadence)
+        return int(env_get("RUSTPDE_INTEGRITY_CADENCE", "8") or 8)
 
 
 @dataclass
@@ -843,6 +888,15 @@ class ServeConfig:
     # admission canonicalization (None = off, the default: requests keep
     # their exact dt and the configured slot count).  See CanonicalConfig.
     canonicalize: CanonicalConfig | None = None
+    # end-to-end integrity (None = off): arms the on-device state digest +
+    # shadow-audit layer (integrity/) on every campaign ensemble — silent
+    # bit flips are caught by the runner's digest audits, contained by
+    # in-memory rollback, charged to the quarantine ledger
+    # (<run_dir>/quarantine.json), and a quarantined device is excluded
+    # from the next campaign's sub-mesh carve.  Done records carry each
+    # member's final state digest so the fleet proxy's cross-replica
+    # voting can compare double-assigned requests bit-for-bit.
+    integrity: IntegrityConfig | None = None
 
 
 @dataclass

@@ -1,0 +1,40 @@
+"""End-to-end integrity: silent-data-corruption defense.
+
+Every failure mode the stack survives elsewhere is *loud* — NaNs, CFL
+blowups, crashes, collective desync.  The failure mode that corrupts
+science quietly is silent data corruption from marginal cores and HBM bit
+flips: finite-but-wrong state that sails past every sentinel and gets
+journaled as a healthy done-record ("Cores that don't count", Hochschild
+et al., HotOS '21).  This package is the detection + containment layer:
+
+* :func:`digest_tree` / :func:`make_digest` — a cheap deterministic
+  on-device fold over the spectral state (bitcast-to-uint32 XOR/add tree
+  with positional mixing), compiled into the model's entry points like
+  the stats engine and streamed with the observables futures.  The
+  digest READS the state and never feeds back: trajectories are
+  bit-identical integrity-on vs integrity-off.
+* shadow re-execution audits (driven by the resilient runner): at a
+  sampled cadence the just-completed chunk is re-dispatched from the
+  retained chunk-start copy and the digests compared — deterministic XLA
+  means bit-equal or corrupted.
+* :class:`IntegrityError` — the typed containment raise, naming
+  chunk/member/device.
+* :class:`QuarantineLedger` — durable per-device strike ledger; repeated
+  strikes journal ``device_quarantined`` and the serve scheduler
+  re-carves sub-meshes around the device.
+* :func:`flip_one_bit` — the deterministic bitflip fault injector's
+  on-device mutation (``RUSTPDE_FAULT=bitflip@<step>``): finite,
+  CFL-sane, invisible to every loud sentinel — caught only here.
+"""
+
+from .digest import digest_tree, flip_one_bit, flip_state_bit
+from .errors import IntegrityError
+from .ledger import QuarantineLedger
+
+__all__ = [
+    "digest_tree",
+    "flip_one_bit",
+    "flip_state_bit",
+    "IntegrityError",
+    "QuarantineLedger",
+]

@@ -68,6 +68,9 @@ CAMPAIGN_MODEL_ATTRS = (
     "clear_pre_divergence",
     "set_stats",
     "stats_armed",
+    "set_integrity",
+    "integrity_armed",
+    "state_digest_async",
     "set_dt",
     "get_dt",
     "get_time",
@@ -143,6 +146,10 @@ class CampaignModelBase:
         self._stats_engine = None
         self.stats_state = None
         self._stats_tick = None
+        # end-to-end integrity layer (integrity/): None = off; set_integrity
+        # arms it — the on-device digest entry point is compiled next to the
+        # step/observables jaxprs and streamed as futures by the runner
+        self._integrity_cfg = None
 
     # -- physics hooks (per subclass) ----------------------------------------
 
@@ -275,6 +282,9 @@ class CampaignModelBase:
         self._stats_health_cc = None
         self._stats_health_consts = None
         self._stats_health_fn = None
+        self._dig_cc = None
+        self._dig_consts = None
+        self._dig_fn = None
         with self._scope():
             step_cc, step_consts = hoist_constants(self._make_step(), example)
             obs_cc, obs_consts = hoist_constants(self._make_observables(), example)
@@ -285,6 +295,11 @@ class CampaignModelBase:
         # physics code path, batch as a leading axis, no forked step
         self._step_cc = step_cc
         self._obs_cc = obs_cc
+
+        # the digest is a pure elementwise+reduction read of the state —
+        # safe on every layout, including the eager fallback below
+        if self._integrity_cfg is not None:
+            self._compile_integrity_entry_points(example)
 
         if self._gspmd_split_sep_fallback():
             self._compile_eager_entry_points()
@@ -793,6 +808,154 @@ class CampaignModelBase:
                 )
             )
 
+    # -- end-to-end integrity (integrity/) ------------------------------------
+
+    def _compile_integrity_entry_points(self, example) -> None:
+        """Hoist + jit the on-device state digest (integrity/digest.py):
+        a pure uint32 read of the state, retained closure-converted
+        (``_dig_cc``/``_dig_consts``) so the ensemble engine re-vmaps the
+        SAME jaxpr over the member axis — per-member digests localize a
+        corrupted member exactly like the observables localize NaNs."""
+        import jax
+
+        from ..integrity import digest_tree
+        from ..utils.jit import hoist_constants
+
+        with self._scope():
+            dig_cc, dig_consts = hoist_constants(digest_tree, example)
+        self._dig_cc = dig_cc
+        self._dig_consts = dig_consts
+        dig_jit = jax.jit(dig_cc)
+        self._dig_fn = lambda s: dig_jit(self._dig_consts, s)
+
+    def set_integrity(self, cfg) -> None:
+        """Arm/disarm (``None``) the integrity layer
+        (:class:`~rustpde_mpi_tpu.config.IntegrityConfig`): compiles the
+        on-device digest entry point.  The digest is a pure consumer of
+        the state — the trajectory stays bit-identical armed vs not (the
+        same CI-asserted contract the stats/sentinel chunks ship under)."""
+        self._integrity_cfg = cfg
+        self._dt_cache.clear()
+        self._compile_entry_points()
+
+    @property
+    def integrity_config(self):
+        return self._integrity_cfg
+
+    @property
+    def integrity_armed(self) -> bool:
+        return (
+            self._integrity_cfg is not None
+            and getattr(self, "_dig_fn", None) is not None
+        )
+
+    def _digest_future(self, device_val):
+        from ..utils.io_pipeline import ObservableFuture
+
+        return ObservableFuture(
+            device_val,
+            convert=lambda v: np.asarray(v)  # lint-ok: RPD005 a replicated uint32 scalar
+        )
+
+    def state_digest_async(self):
+        """Dispatch the on-device digest of the CURRENT state and return
+        an observable future (uint32 scalar; ``(k,)`` per-member vector on
+        ensembles) — streamed by the runner with the observables futures,
+        no extra host sync per chunk."""
+        if not self.integrity_armed:
+            raise RuntimeError(
+                "state_digest_async needs an armed integrity layer "
+                "(set_integrity)"
+            )
+        with self._scope():
+            return self._digest_future(self._dig_fn(self.state))
+
+    def digest_of_async(self, state):
+        """Digest an arbitrary state pytree (the runner's retained
+        chunk-start copies) without touching ``self.state``."""
+        with self._scope():
+            return self._digest_future(self._dig_fn(state))
+
+    def shadow_digest_async(self, snap: dict, n: int):
+        """Shadow re-execution audit kernel: re-step ``n`` steps from the
+        retained :meth:`integrity_snapshot` through the PLAIN chunked path
+        and digest the result.  The snapshot is not consumed (the chunk
+        donates a copy).  The plain chunk is bit-identical to the live
+        sentinel/stats chunks by the pure-consumer contract, and XLA
+        executables are deterministic — a digest differing from the live
+        chunk's means corrupted state."""
+        import jax
+        import jax.numpy as jnp
+
+        from ..utils.jit import run_scanned
+
+        if not self.integrity_armed:
+            raise RuntimeError(
+                "shadow_digest_async needs an armed integrity layer "
+                "(set_integrity)"
+            )
+        with self._scope():
+            st = jax.tree.map(jnp.copy, snap["state"])
+            st = run_scanned(lambda s, k: self._step_n(s, k)[0], st, n)
+            return self._digest_future(self._dig_fn(st))
+
+    def integrity_snapshot(self) -> dict:
+        """Un-donated device-side copy of everything an in-memory
+        integrity rollback must restore (state/time + armed stats)."""
+        import jax
+        import jax.numpy as jnp
+
+        with self._scope():
+            snap = {
+                "state": jax.tree.map(jnp.copy, self.state),
+                "time": self.time,
+            }
+            if self.stats_armed:
+                snap["stats"] = (
+                    jax.tree.map(jnp.copy, self.stats_state),
+                    jnp.copy(self._stats_tick),
+                )
+        return snap
+
+    def integrity_restore(self, snap: dict) -> None:
+        """Roll back to a digest-verified :meth:`integrity_snapshot` (the
+        snapshot stays reusable — the install copies)."""
+        import jax
+        import jax.numpy as jnp
+
+        with self._scope():
+            self.state = jax.tree.map(jnp.copy, snap["state"])
+            self.time = snap["time"]
+            if "stats" in snap and self.stats_armed:
+                ss, tick = snap["stats"]
+                self.stats_state = jax.tree.map(jnp.copy, ss)
+                self._stats_tick = jnp.copy(tick)
+        self._obs_cache = None
+        self._pre_div_latch = False
+
+    def _verify_restored_digest(self, expected) -> None:
+        """Recompute the on-device digest after a (bit-exact, sharded)
+        restore and compare with the manifest's — the device→disk→device
+        loop the host-side sha256 cannot close.  No-op when the
+        checkpoint predates the integrity layer or it is disarmed."""
+        if expected is None or not self.integrity_armed:
+            return
+        got = np.asarray(  # lint-ok: RPD005 fully-replicated uint32 digest
+            self.state_digest_async().result()
+        )
+        exp = np.asarray(  # lint-ok: RPD005 manifest root data, host array
+            expected
+        ).astype(got.dtype).reshape(got.shape)
+        if not np.array_equal(got, exp):
+            from ..integrity import IntegrityError
+
+            raise IntegrityError(
+                f"restored state digest {got.tolist()} does not match the "
+                f"checkpoint manifest digest {exp.tolist()} — the snapshot "
+                "was corrupted between device and disk",
+                check="checkpoint",
+            )
+
     def get_time(self) -> float:
         return self.time
 
@@ -824,6 +987,9 @@ class CampaignModelBase:
         "_stats_health_cc",
         "_stats_health_consts",
         "_stats_health_fn",
+        "_dig_cc",
+        "_dig_consts",
+        "_dig_fn",
     )
 
     def _dt_artifacts(self) -> dict:
@@ -964,10 +1130,20 @@ class CampaignModelBase:
         self.apply_restored_stats(self._stats_engine.split_restored(updates))
 
     def snapshot_root_items(self) -> list:
-        """Replicated host-side data for the sharded manifest root."""
+        """Replicated host-side data for the sharded manifest root.  With
+        the integrity layer armed the on-device state digest rides the
+        manifest: the sharded format is bit-exact, so a restore recomputes
+        and compares it (:meth:`_verify_restored_digest`) — a verified
+        checkpoint closes the device→disk→device loop."""
         items = [("time", np.asarray(float(self.time), dtype=np.float64), "raw")]
         for key, value in getattr(self, "params", {}).items():
             items.append((key, np.asarray(float(value), dtype=np.float64), "raw"))
+        if self.integrity_armed:
+            items.append((
+                "integrity_digest",
+                np.asarray(self.state_digest_async().result()),  # lint-ok: RPD005 a replicated uint32 scalar
+                "raw",
+            ))
         return items
 
     def apply_restored_state(self, updates: dict, attrs: dict, root: dict) -> None:
@@ -981,6 +1157,7 @@ class CampaignModelBase:
         self.time = float(np.asarray(root["time"]))
         self._obs_cache = None
         self._pre_div_latch = False
+        self._verify_restored_digest(root.get("integrity_digest"))
 
     # -- compatibility bucketing ----------------------------------------------
 

@@ -281,6 +281,14 @@ class NavierEnsemble(Integrate):
         self._step_n_sent = None
         self._step_n_stats = None
         self._stats_health_fn = None
+        self._dig_fn = None
+
+        if model._dig_cc is not None:
+            # the per-member digest is a pure elementwise+reduction read of
+            # the stacked states — safe on every layout, including the
+            # eager fallback below (the template model compiles its own
+            # digest before ITS fallback return for the same reason)
+            self._compile_integrity_entry_points()
 
         if model._gspmd_split_sep_fallback():
             # same poisoned layout the single-run guard reroutes (fused
@@ -567,6 +575,19 @@ class NavierEnsemble(Integrate):
         self._step_n_sent = lambda c, n: sent_jit(
             model._sent_consts, model._stats_consts, c, n=n
         )
+
+    def _compile_integrity_entry_points(self) -> None:
+        """Vmapped on-device state digest (integrity/digest.py): the
+        template model's retained digest jaxpr re-vmapped over the member
+        axis — ONE fused dispatch returns a ``(K,)`` uint32 vector, one
+        digest per member, localizing a corrupted member exactly like the
+        observables localize NaNs.  The digest's positional mix uses
+        LOGICAL indices, so member ``i``'s entry equals the digest the
+        same state would produce solo (the layout-invariance the tests
+        assert)."""
+        model = self.model
+        dig_jit = jax.jit(jax.vmap(model._dig_cc, in_axes=(None, 0)))
+        self._dig_fn = lambda st: dig_jit(model._dig_consts, st)
 
     def _make_step(self):
         """vmapped single-member step — profiling.step_flops introspects this
@@ -873,6 +894,130 @@ class NavierEnsemble(Integrate):
                 self.model.stats_engine.restore_state(data, k=self.k)
             )
 
+    # -- end-to-end integrity (integrity/) ------------------------------------
+
+    def set_integrity(self, cfg) -> None:
+        """Arm/disarm the integrity layer on the shared template model and
+        re-vmap the ensemble entry points on top (the per-member digest
+        rides the same retained jaxpr, ``_compile_integrity_entry_points``)."""
+        self.model.set_integrity(cfg)
+        self._dt_cache.clear()
+        self._compile_entry_points()
+
+    @property
+    def integrity_config(self):
+        """The template model's integrity config (None when disarmed)."""
+        return self.model.integrity_config
+
+    @property
+    def integrity_armed(self) -> bool:
+        return (
+            self.model.integrity_config is not None
+            and getattr(self, "_dig_fn", None) is not None
+        )
+
+    def _digest_future(self, device_val):
+        from ..utils.io_pipeline import ObservableFuture
+
+        return ObservableFuture(
+            device_val,
+            convert=lambda v: np.asarray(v)  # lint-ok: RPD005 a (K,) uint32 vector
+        )
+
+    def state_digest_async(self):
+        """Dispatch the vmapped digest of the CURRENT member states and
+        return an observable future of a ``(K,)`` uint32 vector — one
+        digest per member (a mismatch names the corrupted member)."""
+        if not self.integrity_armed:
+            raise RuntimeError(
+                "state_digest_async needs an armed integrity layer "
+                "(set_integrity)"
+            )
+        with self.model._scope():
+            return self._digest_future(self._dig_fn(self.state))
+
+    def digest_of_async(self, state):
+        """Digest an arbitrary stacked state pytree (the runner's retained
+        chunk-start copies) without touching ``self.state``."""
+        with self.model._scope():
+            return self._digest_future(self._dig_fn(state))
+
+    def shadow_digest_async(self, snap: dict, n: int):
+        """Shadow re-execution audit kernel (ensemble form): re-step ``n``
+        steps from the retained :meth:`integrity_snapshot` through the
+        PLAIN batched chunk — threading the snapshot's alive mask and step
+        counters, so per-member freeze decisions replay exactly — and
+        digest the resulting member states.  Bit-equal to the live chunk's
+        digests by XLA determinism, unless the state was corrupted."""
+        from ..utils.jit import run_scanned
+
+        if not self.integrity_armed:
+            raise RuntimeError(
+                "shadow_digest_async needs an armed integrity layer "
+                "(set_integrity)"
+            )
+        with self.model._scope():
+            carry = jax.tree.map(
+                jnp.copy, (snap["state"], snap["mask"], snap["steps_done"])
+            )
+            carry = run_scanned(
+                lambda c, k: self._step_n(c[0], c[1], c[2], k), carry, n
+            )
+            return self._digest_future(self._dig_fn(carry[0]))
+
+    def integrity_snapshot(self) -> dict:
+        """Un-donated device-side copy of everything an in-memory
+        integrity rollback must restore: member states + alive mask +
+        per-member counters + time (+ armed stats sums)."""
+        with self.model._scope():
+            snap = {
+                "state": jax.tree.map(jnp.copy, self.state),
+                "mask": jnp.copy(self.mask),
+                "steps_done": jnp.copy(self.steps_done),
+                "time": self.time,
+            }
+            if self.stats_armed:
+                snap["stats"] = (
+                    jax.tree.map(jnp.copy, self.stats_state),
+                    jnp.copy(self._stats_tick),
+                )
+        return snap
+
+    def integrity_restore(self, snap: dict) -> None:
+        """Roll back to a digest-verified :meth:`integrity_snapshot` (the
+        snapshot stays reusable — the install copies)."""
+        with self.model._scope():
+            self.state = jax.tree.map(jnp.copy, snap["state"])
+            self.mask = jnp.copy(snap["mask"])
+            self.steps_done = jnp.copy(snap["steps_done"])
+            self.time = snap["time"]
+            if "stats" in snap and self.stats_armed:
+                ss, tick = snap["stats"]
+                self.stats_state = jax.tree.map(jnp.copy, ss)
+                self._stats_tick = jnp.copy(tick)
+        self._obs_cache = None
+        self._pre_div_latch = False
+
+    def _verify_restored_digest(self, expected) -> None:
+        """Recompute the per-member digests after a bit-exact sharded
+        restore and compare with the manifest's ``(K,)`` vector (see
+        ``CampaignModelBase._verify_restored_digest``)."""
+        if expected is None or not self.integrity_armed:
+            return
+        got = np.asarray(self.state_digest_async().result())
+        exp = np.asarray(expected).astype(got.dtype).reshape(got.shape)
+        if not np.array_equal(got, exp):
+            from ..integrity import IntegrityError
+
+            bad = [int(i) for i in np.flatnonzero(got != exp)]
+            raise IntegrityError(
+                f"restored member digests differ from the checkpoint "
+                f"manifest for members {bad} — the snapshot was corrupted "
+                "between device and disk",
+                check="checkpoint",
+                member=bad[0] if bad else None,
+            )
+
     @property
     def pre_divergence_latched(self) -> bool:
         """True while an unacknowledged sentinel catch latches ``exit()`` —
@@ -904,6 +1049,7 @@ class NavierEnsemble(Integrate):
         "_step_n_sent",
         "_step_n_stats",
         "_stats_health_fn",
+        "_dig_fn",
     )
 
     def set_dt(self, dt: float) -> None:
@@ -1185,6 +1331,12 @@ class NavierEnsemble(Integrate):
         )
         for key, value in self.model.params.items():
             items.append((key, np.asarray(float(value), dtype=np.float64), "raw"))
+        if self.integrity_armed:
+            items.append((
+                "integrity_digest",
+                np.asarray(self.state_digest_async().result()),  # lint-ok: RPD005 (K,) uint32 manifest row
+                "raw",
+            ))
         return items
 
     def apply_restored_state(self, updates: dict, attrs: dict, root: dict) -> None:
@@ -1199,6 +1351,7 @@ class NavierEnsemble(Integrate):
         self.time = float(np.asarray(root["time"]))
         self._obs_cache = None
         self._pre_div_latch = False
+        self._verify_restored_digest(root.get("integrity_digest"))
 
     def write(self, filename: str) -> None:
         """Write a K-member snapshot (per-member groups, utils/checkpoint)."""

@@ -618,6 +618,11 @@ class Navier2D(CampaignModelBase, Integrate):
             stats_cfg = config.StatsConfig()
         if stats_cfg is not None:
             model.set_stats(stats_cfg)
+        integ_cfg = getattr(cfg, "integrity", None)
+        if integ_cfg is None and config.env_get("RUSTPDE_INTEGRITY") == "1":
+            integ_cfg = config.IntegrityConfig()
+        if integ_cfg is not None:
+            model.set_integrity(integ_cfg)
         return model
 
     def _build_bc_fields(self, xs: np.ndarray, ys: np.ndarray) -> None:

@@ -44,6 +44,8 @@ verdict, and the proxy serves the aggregate on /stats and /healthz.
 
 from __future__ import annotations
 
+import dataclasses
+import hashlib
 import hmac
 import json
 import os
@@ -88,7 +90,9 @@ def write_replica_heartbeat(run_dir: str, replica_id: str, payload: dict) -> Non
     )
 
 
-def read_replica_status(run_dir: str, ttl_s: float) -> list[dict]:
+def read_replica_status(
+    run_dir: str, ttl_s: float, journal=None
+) -> list[dict]:
     """Every replica's last heartbeat, staleness-marked: ``stale`` is true
     when the heartbeat file has not been rewritten for ``ttl_s`` (file
     mtime vs this process's clock — display-grade; the authoritative
@@ -99,7 +103,18 @@ def read_replica_status(run_dir: str, ttl_s: float) -> list[dict]:
     missing replica: it surfaces as a ``stale`` + ``torn`` entry with a
     warning, so autoscalers and dashboards see a sick replica instead of
     silently forgetting one.  Files that vanish mid-scan (replica
-    retirement unlinking its heartbeat) are still skipped."""
+    retirement unlinking its heartbeat) are still skipped.
+
+    **Clock-step hardening** (fleet/clock.py): a wall clock that stepped
+    FORWARD past the staleness window since the last scan would mark
+    every replica stale at once — an NTP correction read as a fleet-wide
+    death.  The shared :data:`~rustpde_mpi_tpu.serve.fleet.clock.MONITOR`
+    detects the step against monotonic time, journals a one-shot
+    ``clock_skew`` row (``journal`` optional), and this scan compensates
+    ages by the step instead of mass-expiring; a BACKWARD step (mtimes
+    ahead of our clock) clamps ages to zero rather than going negative."""
+    from .clock import MONITOR
+
     root = replicas_dir(run_dir)
     out = []
     try:
@@ -107,6 +122,9 @@ def read_replica_status(run_dir: str, ttl_s: float) -> list[dict]:
     except OSError:
         return out
     now = time.time()
+    skew = MONITOR.check(
+        float(ttl_s), journal=journal, where="replica_heartbeats"
+    )
     for name in names:
         if not name.endswith(".json"):
             continue
@@ -115,6 +133,9 @@ def read_replica_status(run_dir: str, ttl_s: float) -> list[dict]:
             age = now - os.stat(path).st_mtime
         except OSError:
             continue  # unlinked between listdir and stat
+        if skew > 0.0:
+            age -= skew  # forward step inflated every age by the step
+        age = max(0.0, age)  # backward step / writer clock ahead of ours
         try:
             with open(path, encoding="utf-8") as fh:
                 rec = json.load(fh)
@@ -169,10 +190,22 @@ class FleetProxy:
         registry=None,
         auth_tokens: list[str] | None = None,
         submesh=None,
+        vote_rate: float | None = None,
     ):
         self.run_dir = run_dir
         self.fleet = fleet
         self.submesh = submesh
+        if vote_rate is None:
+            try:
+                vote_rate = float(env_get("RUSTPDE_VOTE_RATE") or "0")
+            except ValueError:
+                vote_rate = 0.0
+        # cross-replica voting (the integrity tentpole's fleet check):
+        # the fraction of admitted requests double-assigned as an
+        # independent ".vote" twin whose done-record state digest is
+        # compared against the original's (check_votes)
+        self.vote_rate = min(1.0, max(0.0, float(vote_rate)))
+        self._votes_seen: set[str] = set()
         if auth_tokens is None:
             raw = env_get("RUSTPDE_PROXY_TOKENS") or ""
             auth_tokens = [t.strip() for t in raw.split(",") if t.strip()]
@@ -268,6 +301,15 @@ class FleetProxy:
             )
         req = SimRequest.from_dict(data)
         req.validate()
+        if (
+            self.queue.dedupe_lookup(getattr(req, "idempotency_key", None))
+            is not None
+        ):
+            # idempotent retry: skip quota/sub-mesh re-judgement, replay
+            # the original submit's identity (queue._dedupe_into via
+            # queue.submit — nothing is enqueued)
+            req = self.queue.submit(req)
+            return self._ack_deduped(req)
         if self.submesh is not None:
             # stamp sharded grids with their sub-mesh shape at the DOOR, so
             # every proxy and the root front bucket the same grid the same
@@ -321,6 +363,9 @@ class FleetProxy:
                 )
                 raise
         self.queue.submit(req)
+        if getattr(req, "deduped", False):
+            # lost a concurrent same-key race inside queue.submit
+            return self._ack_deduped(req)
         _tm.counter(
             "fleet_proxy_admitted_total", "requests admitted via this proxy"
         ).inc()
@@ -335,16 +380,138 @@ class FleetProxy:
                 "via": "proxy",
             }
         )
+        if self._vote_sampled(req):
+            self._assign_vote(req)
         return req
+
+    def _ack_deduped(self, req: SimRequest) -> SimRequest:
+        _tm.counter(
+            "fleet_proxy_deduped_total",
+            "retries answered from the idempotency index via this proxy",
+        ).inc()
+        self._journal(
+            {
+                "event": "request_deduped",
+                "id": req.id,
+                "trace_id": req.trace_id,
+                "idempotency_key": req.idempotency_key,
+                "via": "proxy",
+            }
+        )
+        return req
+
+    # -- cross-replica voting (integrity/) ------------------------------------
+
+    def _vote_sampled(self, req: SimRequest) -> bool:
+        """Deterministic per-id sampling at ``vote_rate`` (never a vote of
+        a vote): every proxy derives the same verdict from the id, so a
+        retry routed through a different front cannot double-vote."""
+        if self.vote_rate <= 0.0 or req.id.endswith(".vote"):
+            return False
+        h = int(hashlib.sha256(req.id.encode("utf-8")).hexdigest()[:8], 16)
+        return (h / float(0xFFFFFFFF)) < self.vote_rate
+
+    def _assign_vote(self, req: SimRequest) -> None:
+        """Double-assign one sampled request: an independent ``.vote``
+        twin (same physics, seed, and dt — a deterministic executable
+        yields a bit-equal end state) is enqueued as ordinary work.  When
+        both done-records exist, :meth:`check_votes` compares their state
+        digests: a disagreement is silent corruption that BOTH executions'
+        own audits missed — the strongest end-to-end check the fleet has.
+        Best-effort: a twin the queue rejects (backpressure) is dropped,
+        the original request is never affected."""
+        twin = dataclasses.replace(
+            req,
+            id=f"{req.id}.vote",
+            idempotency_key=None,
+            trace=None,  # __post_init__ mints the twin its own trace
+            dts=list(req.dts),
+        )
+        try:
+            self.queue.submit(twin)
+        except AdmissionError:
+            return
+        _tm.counter(
+            "fleet_votes_assigned_total",
+            "sampled requests double-assigned for digest voting",
+        ).inc()
+        self._journal(
+            {"event": "vote_assigned", "id": req.id, "vote_id": twin.id}
+        )
+
+    def _done_record(self, rid: str) -> dict | None:
+        path = os.path.join(self.run_dir, "queue", "done", f"{rid}.json")
+        try:
+            with open(path, encoding="utf-8") as fh:
+                return json.load(fh)
+        except (OSError, ValueError):
+            return None
+
+    def check_votes(self) -> list[dict]:
+        """Resolve completed vote pairs: for every ``<id>.vote`` done
+        record whose original is also done, compare the two
+        ``state_digest`` values and journal the verdict —
+        ``integrity_vote`` always, ``integrity_vote_mismatch`` on
+        disagreement (match=None when either record carries no digest:
+        the service ran without integrity armed).  Incomplete pairs wait
+        for a later scan; each pair is verdicted once per proxy process.
+        Called from ``stats()`` so any scrape advances the votes."""
+        done_dir = os.path.join(self.run_dir, "queue", "done")
+        try:
+            names = os.listdir(done_dir)
+        except OSError:
+            return []
+        out = []
+        for name in sorted(names):
+            if not name.endswith(".vote.json"):
+                continue
+            vid = name[: -len(".json")]
+            rid = vid[: -len(".vote")]
+            if rid in self._votes_seen:
+                continue
+            rec_v = self._done_record(vid)
+            rec_o = self._done_record(rid)
+            if rec_v is None or rec_o is None:
+                continue  # pair incomplete — a later scan resolves it
+            self._votes_seen.add(rid)
+            d_orig = (rec_o.get("result") or {}).get("state_digest")
+            d_vote = (rec_v.get("result") or {}).get("state_digest")
+            match = (
+                None
+                if d_orig is None or d_vote is None
+                else bool(int(d_orig) == int(d_vote))
+            )
+            verdict = {
+                "id": rid,
+                "vote_id": vid,
+                "match": match,
+                "digests": [d_orig, d_vote],
+            }
+            _tm.counter(
+                "fleet_votes_resolved_total",
+                "vote pairs verdicted by digest comparison",
+                match=str(match).lower(),
+            ).inc()
+            self._journal({"event": "integrity_vote", **verdict})
+            if match is False:
+                self._journal(
+                    {"event": "integrity_vote_mismatch", **verdict}
+                )
+            out.append(verdict)
+        return out
 
     def stats(self) -> dict:
         self.queue.invalidate()  # other processes write the shared dir
+        self.check_votes()  # advance pending digest votes on every scrape
         return {
             "proxy": self.proxy_id,
+            "votes_checked": len(self._votes_seen),
             "queue": self.queue.counts(),
             "tenants": self.queue.tenant_counts(),
             "leases": self._leases.holders(),
-            "replicas": read_replica_status(self.run_dir, 2.0 * self.ttl_s),
+            "replicas": read_replica_status(
+                self.run_dir, 2.0 * self.ttl_s, journal=self._journal
+            ),
         }
 
     def _make_handler(self):
@@ -435,21 +602,25 @@ class FleetProxy:
                     payload, headers = rejection_payload(
                         exc, proxy.queue.counts()["queued"]
                     )
-                    return reply_json(self, 429, payload, headers)
+                    # storage_full is a 503 (the queue volume hit ENOSPC:
+                    # service impairment, not client backpressure) so load
+                    # balancers fail the proxy over instead of retrying it
+                    code = 503 if exc.reason == "storage_full" else 429
+                    return reply_json(self, code, payload, headers)
                 except (RequestError, ValueError, TypeError) as exc:
                     payload = {"error": str(exc)}
                     reason = getattr(exc, "reason", None)
                     if reason:
                         payload["reason"] = reason
                     return reply_json(self, 400, payload)
-                return reply_json(
-                    self,
-                    202,
-                    {
-                        "id": req.id,
-                        "steps": req.steps,
-                        "trace_id": req.trace_id,
-                    },
-                )
+                payload = {
+                    "id": req.id,
+                    "steps": req.steps,
+                    "trace_id": req.trace_id,
+                }
+                if getattr(req, "deduped", False):
+                    payload["deduped"] = True
+                    return reply_json(self, 200, payload)
+                return reply_json(self, 202, payload)
 
         return Handler

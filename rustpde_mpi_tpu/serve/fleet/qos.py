@@ -41,6 +41,25 @@ import time
 from ...parallel import submesh as _submesh
 from ..request import AdmissionError, RequestError, SimRequest
 
+#: wall-step threshold for the deadline clock: steps smaller than this are
+#: ordinary NTP slew/drift the deadline math can absorb; larger ones are
+#: corrections that would blow every queued deadline at once
+CLOCK_STEP_THRESHOLD_S = 30.0
+
+
+def qos_now() -> float:
+    """The deadline clock: wall time, with forward steps compensated for
+    the detecting scan (fleet/clock.py — one-shot ``clock_skew`` warning,
+    then the step is absorbed as the new normal).  Without this, an NTP
+    forward correction would flag every queued deadline as at-risk in the
+    same boundary and preemption would evict the whole best-effort tier
+    for requests that were comfortably on time a second earlier."""
+    from .clock import MONITOR
+
+    now = time.time()
+    skew = MONITOR.check(CLOCK_STEP_THRESHOLD_S, where="qos_deadlines")
+    return now - skew if skew > 0.0 else now
+
 
 def admit_submesh(
     req: SimRequest, pending_sharded: int, cfg
@@ -106,7 +125,7 @@ def bucket_order(loaded: list, now: float | None = None) -> list[tuple]:
     priority class first, tightest deadline slack second, oldest arrival
     third.  ``loaded`` is the queue's ``(name, SimRequest)`` scan (names
     sort by enqueue time by construction)."""
-    now = time.time() if now is None else now
+    now = qos_now() if now is None else now
     best: dict[tuple, list] = {}
     for name, req in loaded:
         cand = [req.class_rank, req.deadline_slack(now), name]
@@ -122,7 +141,7 @@ def find_at_risk(
     """The most urgent queued deadline-carrying request whose remaining
     slack is below ``slack_s`` — the preemption trigger.  None when every
     deadline still has room (the common case: preemption stays idle)."""
-    now = time.time() if now is None else now
+    now = qos_now() if now is None else now
     at_risk = [
         req
         for _, req in loaded
@@ -147,7 +166,7 @@ def preempt_victims(
         # only the interactive class may preempt: a late BATCH deadline
         # is a scheduling miss, not an emergency worth evicting for
         return []
-    now = time.time()
+    now = qos_now()
     victims = sorted(
         (
             (req.class_rank, req.deadline_slack(now), i)

@@ -7,10 +7,16 @@ thread-safe surface::
     POST /requests        {"ra":1e4,"horizon":0.1,...}  -> 202 {"id", "steps",
                           "trace_id"} — the trace id names the request's
                           whole lifecycle across restarts
+                          an "idempotency_key" field makes retries safe:
+                          a repeat submit with a seen key replays the
+                          original ack as 200 {...,"deduped":true}
+                          instead of enqueueing duplicate work
                           429 {"error","reason","queue_depth",
                           "retry_after_s"} + a Retry-After header on
                           admission rejection (queue_full / draining /
-                          quota), so clients back off intelligently
+                          quota), so clients back off intelligently;
+                          503 + Retry-After when the queue volume is out
+                          of space (reason="storage_full")
                           400 on a malformed request body / bad
                           Content-Length / truncated body, 413 oversized
     GET  /requests/<id>   lifecycle record               (404 unknown)
@@ -264,11 +270,15 @@ class HttpFront:
                 except AdmissionError as exc:
                     # 429 with a Retry-After header + the live queue depth
                     # in the body: clients see WHY and for HOW LONG, not a
-                    # bare reason string
+                    # bare reason string.  A storage_full reject is a 503:
+                    # the SERVICE is impaired (the queue volume hit
+                    # ENOSPC), not the client over a bound — load
+                    # balancers fail over on 5xx, which is the right call
                     payload, headers = rejection_payload(
                         exc, sim.queue.counts()["queued"]
                     )
-                    return self._reply(429, payload, headers)
+                    code = 503 if exc.reason == "storage_full" else 429
+                    return self._reply(code, payload, headers)
                 except (RequestError, ValueError, TypeError) as exc:
                     # typed malformed-request rejects (e.g. the sub-mesh
                     # admission's "no_submesh") carry a machine-readable
@@ -278,9 +288,16 @@ class HttpFront:
                     if reason:
                         payload["reason"] = reason
                     return self._reply(400, payload)
-                return self._reply(
-                    202,
-                    {"id": req.id, "steps": req.steps, "trace_id": req.trace_id},
-                )
+                payload = {
+                    "id": req.id,
+                    "steps": req.steps,
+                    "trace_id": req.trace_id,
+                }
+                if getattr(req, "deduped", False):
+                    # idempotent retry: replay the ORIGINAL ack (200, not
+                    # 202 — nothing new was accepted) with the marker
+                    payload["deduped"] = True
+                    return self._reply(200, payload)
+                return self._reply(202, payload)
 
         return Handler

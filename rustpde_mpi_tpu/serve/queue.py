@@ -30,6 +30,8 @@ from handler threads while the scheduler claims from the campaign loop).
 from __future__ import annotations
 
 import bisect
+import errno
+import hashlib
 import json
 import os
 import threading
@@ -80,11 +82,26 @@ class DurableQueue:
         """Validate + admit one request into ``queued/``.
 
         Raises :class:`RequestError` (malformed — never admitted) or
-        :class:`AdmissionError` (``queue_full`` backpressure, or
-        ``draining`` when the owning service flipped ``admit_open`` off).
-        Returns the request with its id/submit-time stamped."""
+        :class:`AdmissionError` (``queue_full`` backpressure, ``draining``
+        when the owning service flipped ``admit_open`` off, or
+        ``storage_full`` when the durable write itself hit ENOSPC — an
+        un-fsyncable admission must never be acknowledged).
+        Returns the request with its id/submit-time stamped.
+
+        **Idempotent retries**: a request carrying an ``idempotency_key``
+        already present in the durable dedupe index is NOT re-enqueued —
+        the returned request bears the ORIGINAL submit's id/trace and
+        ``req.deduped`` is set, so the front replays the original ack.
+        The dedupe check runs BEFORE every admission bound: a retry of
+        already-accepted work must get its ack back even through a full
+        queue or a draining service."""
         req.validate()
         with self._lock:
+            key = getattr(req, "idempotency_key", None)
+            if key:
+                prior = self.dedupe_lookup(key)
+                if prior is not None:
+                    return self._dedupe_into(req, prior)
             if not admit_open:
                 raise AdmissionError(
                     "draining",
@@ -98,8 +115,99 @@ class DurableQueue:
                     "backoff",
                     retry_after_s=5.0,
                 )
-            self._enqueue(req)
+            try:
+                self._enqueue(req)
+            except OSError as exc:
+                if exc.errno == errno.ENOSPC:
+                    raise AdmissionError(
+                        "storage_full",
+                        "the queue volume has no space left; admission "
+                        "refused until storage is reclaimed",
+                        retry_after_s=30.0,
+                    ) from exc
+                raise
+            if key:
+                winner = self._idem_claim(req)
+                if winner is not None and winner.get("id") != req.id:
+                    # lost a concurrent same-key race by one dirent:
+                    # withdraw our duplicate and answer with the winner
+                    self._withdraw_queued(req.id)
+                    return self._dedupe_into(req, winner)
         return req
+
+    # -- idempotency (the dedupe index) ---------------------------------------
+
+    def _idem_dir(self) -> str:
+        return os.path.join(self.root, "idempotency")
+
+    def _idem_path(self, key: str) -> str:
+        digest = hashlib.sha256(key.encode("utf-8")).hexdigest()[:40]
+        return os.path.join(self._idem_dir(), f"{digest}.json")
+
+    def dedupe_lookup(self, key) -> dict | None:
+        """The durable index record for one idempotency key — ``{"id",
+        "trace_id", "key"}`` of the submit that claimed it — or None for
+        an unseen (or falsy) key."""
+        if not key:
+            return None
+        try:
+            with open(self._idem_path(key), encoding="utf-8") as fh:
+                return json.load(fh)
+        except (OSError, ValueError):
+            return None
+
+    def _idem_claim(self, req: SimRequest) -> dict | None:
+        """Claim ``req``'s key in the index via O_EXCL dirent creation —
+        exactly one of N racing same-key submits wins.  Returns None on a
+        win, the winner's record on a loss.  The index is written AFTER
+        the enqueue: a crash between the two degrades to at-least-once
+        (the retry re-runs the physics — a dup result, never a lost or
+        ghost request), which is the right failure direction.  An index
+        write that itself fails is swallowed the same way."""
+        path = self._idem_path(req.idempotency_key)
+        record = {
+            "id": req.id,
+            "trace_id": req.trace_id,
+            "key": req.idempotency_key,
+        }
+        try:
+            os.makedirs(self._idem_dir(), exist_ok=True)
+            fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_EXCL)
+        except FileExistsError:
+            return self.dedupe_lookup(req.idempotency_key)
+        except OSError:
+            return None  # degraded: no index entry, dedupe waived
+        try:
+            with os.fdopen(fd, "w", encoding="utf-8") as fh:
+                fh.write(json.dumps(record, sort_keys=True))
+                fh.flush()
+                os.fsync(fh.fileno())
+            _fsync_dir(self._idem_dir())
+        except OSError:
+            pass
+        return None
+
+    def _dedupe_into(self, req: SimRequest, prior: dict) -> SimRequest:
+        """Rewrite ``req`` into the original submit's identity so the
+        caller's ack (id/steps/trace_id) replays the first answer; the
+        ``deduped`` marker tells fronts to journal ``request_deduped``
+        instead of admitting."""
+        req.deduped = True
+        req.id = prior.get("id") or req.id
+        if prior.get("trace_id"):
+            req.trace = {"trace_id": prior["trace_id"]}
+        return req
+
+    def _withdraw_queued(self, request_id: str) -> None:
+        """Remove our just-enqueued file for ``request_id`` (the loser of
+        an idempotency race): the winner's copy is the one true submit."""
+        for name in list(self._queued_files()):
+            if name.endswith(f"-{request_id}.json"):
+                try:
+                    os.remove(os.path.join(self._dir("queued"), name))
+                except OSError:
+                    pass
+                self._evict(name)
 
     def _enqueue(self, req: SimRequest) -> None:
         """Write the queued file (caller holds the lock).  The FIRST durable

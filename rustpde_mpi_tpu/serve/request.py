@@ -45,11 +45,14 @@ class RequestError(ValueError):
 class AdmissionError(RuntimeError):
     """The service refused to admit a request — bounded-queue backpressure
     (``reason="queue_full"``), a draining/stopped service
-    (``reason="draining"``), or a tenant over its QoS quota
-    (``reason="quota"``).  Typed reject-with-reason instead of an
-    unbounded backlog: the client backs off or routes elsewhere.
-    ``retry_after_s`` is the back-off hint the HTTP 429 surfaces as a
-    ``Retry-After`` header."""
+    (``reason="draining"``), a tenant over its QoS quota
+    (``reason="quota"``), or a queue volume with no space left
+    (``reason="storage_full"`` — the durable-enqueue write hit ENOSPC;
+    admitting without the fsynced file would break the never-lost
+    contract, so the reject is typed and the HTTP front answers 503).
+    Typed reject-with-reason instead of an unbounded backlog: the client
+    backs off or routes elsewhere.  ``retry_after_s`` is the back-off
+    hint the HTTP 429/503 surfaces as a ``Retry-After`` header."""
 
     def __init__(self, reason: str, detail: str, retry_after_s: float = 5.0):
         super().__init__(f"request rejected ({reason}): {detail}")
@@ -130,6 +133,12 @@ class SimRequest:
     tenant: str = "default"
     priority: str = "batch"
     deadline_s: float | None = None
+    # client-chosen idempotency key (serve/queue.py dedupe index): a retry
+    # of an acked-but-unobserved submit (timeout, dropped 202, LB failover
+    # to another proxy) carrying the same key is answered with the ORIGINAL
+    # request's id instead of enqueueing duplicate work.  Never joins
+    # compat_key; None (the default) opts out entirely.
+    idempotency_key: str | None = None
     seed: int = 0
     amp: float | None = None  # IC amplitude (None: ServeConfig.default_amp)
     # sub-mesh stamp (two-level serving, parallel/submesh.py): 0 = vmapped
@@ -195,6 +204,20 @@ class SimRequest:
             raise RequestError(
                 f"submesh stamp must be >= 0, got {self.submesh}"
             )
+        if self.idempotency_key is not None:
+            if (
+                not isinstance(self.idempotency_key, str)
+                or not self.idempotency_key.strip()
+            ):
+                raise RequestError(
+                    "idempotency_key must be a non-empty string (or null), "
+                    f"got {self.idempotency_key!r}"
+                )
+            if len(self.idempotency_key) > 256:
+                raise RequestError(
+                    "idempotency_key longer than 256 characters "
+                    f"({len(self.idempotency_key)})"
+                )
         from ..workloads.registry import model_kinds
 
         if self.model not in model_kinds():

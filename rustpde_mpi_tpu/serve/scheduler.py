@@ -104,6 +104,7 @@ from ..utils import checkpoint
 from ..workloads.registry import build_model_for_key
 from ..utils.faults import FaultPlan, validate_fault_env
 from ..utils.journal import JournalWriter, read_journal
+from ..integrity import IntegrityError
 from ..utils.resilience import DispatchHang, ResilientRunner
 from .fleet.gang import GangMemberLost
 from .queue import DurableQueue
@@ -326,6 +327,10 @@ class SimServer:
             )
         self._submesh_plan: _sm.SubmeshPlan | None = None
         self._submesh_meshes: dict[int, object] = {}
+        # flipped by _contain_integrity when a device of THIS replica is
+        # quarantined: the heartbeat carries it so the fleet proxy routes
+        # new work to healthy replicas (the autoscaler replaces us)
+        self._integrity_unhealthy = False
         self._active_mesh = None
         self._active_share: tuple[int, int] | None = None
         self._gang_placement: tuple | None = None  # (Submesh, replanned)
@@ -446,6 +451,15 @@ class SimServer:
             req.amp = float(self.cfg.default_amp)
         if self._canon_ladder is not None:
             self._canonicalize(req)
+        if (
+            self.queue.dedupe_lookup(getattr(req, "idempotency_key", None))
+            is not None
+        ):
+            # a retry of already-accepted work: admission policy (quota,
+            # sub-mesh stamping, backpressure) must not re-judge it — the
+            # queue replays the original submit's identity, nothing is
+            # enqueued, and the front re-acks the first answer
+            return self._ack_deduped(self.queue.submit(req))
         if self._submesh is not None:
             # two-level serving admission: stamp the sub-mesh shape the
             # grid needs (compat_key gains the stamp, so sharded buckets
@@ -518,6 +532,9 @@ class SimServer:
                 reason=exc.reason,
             ).inc()
             raise
+        if getattr(req, "deduped", False):
+            # lost a concurrent same-key race inside queue.submit
+            return self._ack_deduped(req)
         queued = self.queue.counts()["queued"]
         _tm.counter("serve_requests_admitted_total", "requests admitted").inc()
         _tm.gauge("serve_queue_depth", "requests waiting in queued/").set(queued)
@@ -529,6 +546,23 @@ class SimServer:
                 "key": list(req.compat_key),
                 "steps": req.steps,
                 "queued": queued,
+            }
+        )
+        return req
+
+    def _ack_deduped(self, req: SimRequest) -> SimRequest:
+        """Journal + count one idempotent-retry hit; the returned request
+        bears the ORIGINAL submit's id/trace (queue._dedupe_into)."""
+        _tm.counter(
+            "serve_requests_deduped_total",
+            "retries answered from the idempotency index",
+        ).inc()
+        self._journal(
+            {
+                "event": "request_deduped",
+                "id": req.id,
+                "trace_id": req.trace_id,
+                "idempotency_key": req.idempotency_key,
             }
         )
         return req
@@ -732,9 +766,13 @@ class SimServer:
     def _carve_plan(self) -> _sm.SubmeshPlan:
         """The carved device plan, built once per incarnation.  Every
         process derives the IDENTICAL plan from the globally-consistent
-        ``jax.devices()`` order — no broadcast needed — and a restart
-        after a fleet resize re-carves automatically (the elastic
-        re-planner: stamped buckets re-place through ``plan.place``)."""
+        ``jax.devices()`` order — and from the root-broadcast quarantine
+        verdict: devices the integrity ledger quarantined are excluded
+        from the carve, so later campaigns route around suspect silicon.
+        A restart after a fleet resize re-carves automatically (the
+        elastic re-planner: stamped buckets re-place through
+        ``plan.place``); an integrity quarantine drops the cached plan
+        (:meth:`_contain_integrity`) to force the same re-carve."""
         if self._submesh_plan is None:
             try:
                 import jax
@@ -742,10 +780,54 @@ class SimServer:
                 devices = jax.devices()
             except Exception:
                 devices = []
+            bad = self._quarantined_devices()
+            if bad and devices:
+                keep = [
+                    d
+                    for d in devices
+                    if "%s:%s@proc%s"
+                    % (
+                        getattr(d, "platform", "cpu"),
+                        getattr(d, "id", 0),
+                        int(getattr(d, "process_index", 0)),
+                    )
+                    not in bad
+                ]
+                # never carve an EMPTY fleet: with every device struck the
+                # quarantine is waived (servability beats suspicion) and
+                # the journal row records the overridden verdict
+                if keep and len(keep) < len(devices):
+                    devices = keep
+                self._journal(
+                    {
+                        "event": "carve_excluded_quarantined",
+                        "devices": sorted(bad),
+                        "kept": len(devices),
+                        "waived": not keep,
+                    }
+                )
             self._submesh_plan = _sm.carve(
                 devices, self._submesh.shapes, nproc=self._nproc()
             )
         return self._submesh_plan
+
+    def _quarantined_devices(self) -> frozenset:
+        """The durable quarantine verdict (integrity/ledger.py), read on
+        ROOT and broadcast — the carve below must be identical on every
+        host, and the ledger file lives in root's run dir."""
+
+        def read():
+            from ..integrity import QuarantineLedger
+
+            icfg = self.cfg.integrity
+            led = QuarantineLedger(
+                self.cfg.run_dir,
+                strikes=icfg.strikes if icfg else 2,
+                strike_ttl_s=icfg.strike_ttl_s if icfg else 3600.0,
+            )
+            return list(led.quarantined())
+
+        return frozenset(self._root_plan(read))
 
     def _submesh_mesh(self, sub):
         """The (cached) jax Mesh over one carved slice; None for an empty
@@ -1148,6 +1230,7 @@ class SimServer:
                 {
                     "draining": self._drain,
                     "stopping": bool(stopping),
+                    "unhealthy": self._integrity_unhealthy,
                     "slots": list(self._slots_state),
                     "completed": self._completed,
                     "failed": self._failed,
@@ -1334,6 +1417,8 @@ class SimServer:
             and getattr(model, "MODEL_KIND", "") == "dns"
         ):
             model.set_stats(self.cfg.stats)
+        if self.cfg.integrity is not None:
+            model.set_integrity(self.cfg.integrity)
         kk = int(k) if k else self._canonical_k()
         ens = _ServedEnsemble(model, [model.state] * kk)
         ens.mark_dead(range(ens.k))
@@ -1395,6 +1480,13 @@ class SimServer:
                 # resets that member's averaging window — per-request stats
                 # start at claim time.
                 model.set_stats(self.cfg.stats)
+            if self.cfg.integrity is not None:
+                # SDC defense (integrity/): on-device state digests streamed
+                # at every chunk boundary + sampled shadow re-execution
+                # audits.  Armed before the ensemble vmaps so the digest
+                # entry point compiles per-member; model-kind agnostic (the
+                # digest folds whatever the state pytree holds).
+                model.set_integrity(self.cfg.integrity)
             ens = _ServedEnsemble(model, [model.state] * k)
             ens.mark_dead(range(ens.k))  # all lanes idle until request lands
             # two phase-stamped compile_build rows cover the campaign build
@@ -1466,6 +1558,19 @@ class SimServer:
         runner.fault = self._fault
         runner.step = self._global_step
         runner.set_journal(self._journal_writer)
+        if self.cfg.integrity is not None:
+            # the quarantine ledger lives at the SERVE root, not in the
+            # per-bucket campaign dir the runner would default to: strikes
+            # must accumulate across campaigns (and replicas sharing the
+            # run dir) for the carve filter to ever see them
+            from ..integrity import QuarantineLedger
+
+            icfg = self.cfg.integrity
+            runner._integ_ledger = QuarantineLedger(
+                self.cfg.run_dir,
+                strikes=icfg.strikes,
+                strike_ttl_s=icfg.strike_ttl_s,
+            )
         return runner, ens
 
     def _peek_checkpoint_members(self, run_dir: str) -> int | None:
@@ -1546,6 +1651,19 @@ class SimServer:
                 self._fill_slots(runner, ens, slots, key)
                 self._refresh_slot_state(slots, ens.k)
                 self._campaign_loop(runner, ens, slots, key)
+        except IntegrityError as exc:
+            # SDC containment (integrity/): the runner detected corruption
+            # it could not roll back past — a device crossed the quarantine
+            # threshold, or no digest-verified state existed.  The raise is
+            # collectively agreed (the quarantine verdict is root-broadcast
+            # in the runner), so every host lands here together: requeue
+            # the running slots from their durable parked progress (device
+            # state is untrusted, never drained), drop the carve plan so
+            # the next campaign excludes the quarantined device, and flag
+            # the replica unhealthy.  Serve CONTINUES — unlike a gang
+            # death, the collective runtime is intact.
+            self._disarm_device_fence(drain=False)
+            self._contain_integrity(key, slots, exc)
         except (GangMemberLost, DispatchHang) as exc:
             # gang fate-sharing: a dead member turned a barrier (typed
             # GangMemberLost from the gang watchdog) or a chunk dispatch
@@ -1827,6 +1945,72 @@ class SimServer:
             break_gang(self._lease_mgr, key, self._nproc())
             with self._hb_lock:
                 self._gang_lease = None
+
+    def _contain_integrity(self, key: tuple, slots: list[_Slot], exc) -> None:
+        """Silent-data-corruption containment: every host runs this
+        together (the IntegrityError raise is collectively agreed).  The
+        cached carve plan is dropped so the NEXT campaign excludes the
+        quarantined device; root requeues every running slot with the
+        progress its durable parked continuation carries — the live device
+        state failed its digest audit and is never drained into a result —
+        and the replica turns unhealthy in its fleet heartbeat."""
+        self._submesh_plan = None
+        self._submesh_meshes.clear()
+        if getattr(exc, "device", None):
+            self._integrity_unhealthy = True
+        _tm.counter(
+            "serve_integrity_contained_total",
+            "campaigns abandoned on an unrecoverable integrity failure",
+        ).inc()
+        if not self._is_root():
+            return
+        self._journal(
+            {
+                "event": "integrity_contained",
+                "key": list(key),
+                "check": getattr(exc, "check", None),
+                "step": getattr(exc, "step", None),
+                "member": getattr(exc, "member", None),
+                "device": getattr(exc, "device", None),
+                "detail": str(exc),
+            }
+        )
+        if self._fleet is not None:
+            from .fleet.lease import LeaseLost
+
+            with self._hb_lock:
+                lease = self._lease
+            try:
+                if lease is not None:
+                    lease.guard()
+            except LeaseLost:
+                return
+        for s in slots:
+            if not s.running:
+                continue
+            progress, parked = int(s.base), False
+            meta = checkpoint.continuation_meta(
+                checkpoint.continuation_dir(self.cfg.run_dir, s.req.id)
+            )
+            if meta is not None:
+                progress, parked = int(meta[0]), True
+            self.queue.requeue(
+                dataclasses.replace(s.req, progress=progress)
+            )
+            self._journal(
+                {
+                    "event": "request_requeued",
+                    "id": s.req.id,
+                    "trace_id": s.req.trace_id,
+                    "slot": s.index,
+                    "progress": progress,
+                    "target": s.target,
+                    "parked": parked,
+                    "checkpoint": None,
+                    "integrity": True,
+                }
+            )
+        self._fleet_heartbeat(force=True)
 
     def _try_resume(self, runner) -> None:
         """Campaign restore with graceful degradation: a checkpoint that no
@@ -2572,6 +2756,13 @@ class SimServer:
 
                 stats_fut = ens.stats_health_async()
                 stats_names = HEALTH_NAMES
+            # end-state digest per finished member (integrity armed):
+            # captured with the observables, before any refill — the done
+            # record carries it so the fleet proxy's cross-replica vote can
+            # compare two replicas' results without shipping state
+            dig_fut = None
+            if getattr(ens, "integrity_armed", False):
+                dig_fut = ens.state_digest_async()
             if self._fence_ens is not None:
                 # EVERY host stashes the dispatch handles for the sub-mesh
                 # fence (root alone keeps them in _pending_results): the
@@ -2580,6 +2771,8 @@ class SimServer:
                 self._inflight_futs.append(obs_fut)
                 if stats_fut is not None:
                     self._inflight_futs.append(stats_fut)
+                if dig_fut is not None:
+                    self._inflight_futs.append(dig_fut)
             batch = []
             for d in plan["finished"]:
                 s = slots[d["slot"]]
@@ -2590,6 +2783,7 @@ class SimServer:
                         "names": names,
                         "stats_fut": stats_fut,
                         "stats_names": stats_names,
+                        "dig_fut": dig_fut,
                         "steps": int(d["steps"]),
                         "finished_wall": time.time(),
                         "step": runner.step,
@@ -2987,6 +3181,16 @@ class SimServer:
                         name: float(np.asarray(v).reshape(-1)[i])  # lint-ok: RPD005 future already converted to host numpy
                         for name, v in zip(item["stats_names"], svals)
                     }
+                # end-state integrity digest (cfg.integrity): a content
+                # fingerprint of the member's final spectral state — the
+                # fleet proxy's cross-replica vote compares two replicas'
+                # digests for the same request to catch SDC neither
+                # replica's own audits saw
+                dfut = item.get("dig_fut")
+                if dfut is not None:
+                    result["state_digest"] = int(
+                        np.asarray(dfut.result()).reshape(-1)[i]  # lint-ok: RPD005 future already converted to host numpy
+                    )
                 self.queue.complete(req, result)
                 self._completed += 1
                 _tm.counter(

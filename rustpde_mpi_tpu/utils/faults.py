@@ -18,7 +18,7 @@ from __future__ import annotations
 import dataclasses
 import os
 
-FAULT_KINDS = ("nan", "spike", "kill", "slow")
+FAULT_KINDS = ("nan", "spike", "kill", "slow", "bitflip")
 SHARD_CRASH_POINTS = ("after_shard", "before_manifest")
 
 
@@ -98,7 +98,15 @@ class FaultPlan:
       need ``RUSTPDE_SYNC_TIMEOUT_S`` to convert the wedge into a
       structured ``DispatchHang``),
     * ``slow``  — stall the next dispatch past the watchdog deadline (the
-      ``DispatchHang`` path); host-scoped, only that host stalls.
+      ``DispatchHang`` path); host-scoped, only that host stalls,
+    * ``bitflip`` — flip ONE high-mantissa bit of one spectral coefficient
+      on-device (deterministically positioned from ``step``): the state
+      stays finite and CFL-sane, so this is INVISIBLE to every loud
+      sentinel and caught only by the integrity layer's digest audits.
+      Host-scoped, the flipped coefficient lives in a column owned by that
+      host's devices (every process computes the same flip so collective
+      dispatch stays aligned); ``:member<k>`` scopes the flip to one
+      ensemble member's slice, exercising per-member digest localization.
 
     GANG scope (``:gang<g>`` or ``:gang<g>member<m>``, two-level serving):
     the fault acts only inside the gang campaign the scheduler BINDS at
@@ -119,6 +127,10 @@ class FaultPlan:
     host: int | None = None
     gang: int | None = None
     member: int | None = None
+    # bare ensemble-member scope (``:member<k>``, no gang): acts on every
+    # process (the member axis is vmapped, not sharded) but the injected
+    # corruption touches only member k's leading-axis slice
+    only_member: int | None = None
     fired: bool = False
     # runtime binding (not part of the spec): the scheduler sets these at
     # gang-campaign open and clears them at close — None = not in a gang
@@ -126,7 +138,10 @@ class FaultPlan:
     bound_member: int | None = None
 
     KINDS = FAULT_KINDS
-    EXPECTED = "<nan|spike|kill|slow>@<step>[:host<p>|:gang<g>[member<m>]]"
+    EXPECTED = (
+        "<nan|spike|kill|slow|bitflip>@<step>"
+        "[:host<p>|:member<k>|:gang<g>[member<m>]]"
+    )
 
     @classmethod
     def from_spec(cls, spec: str | None) -> "FaultPlan | None":
@@ -142,13 +157,25 @@ class FaultPlan:
             raise FaultSpecError(
                 spec, cls.EXPECTED, f"bad step {at!r}, expected an integer"
             ) from None
-        host = gang = member = None
+        host = gang = member = only_member = None
         if hsep:
             if scope.startswith("gang"):
                 gang, member = _parse_gang_scope(scope, spec, cls.EXPECTED)
+            elif scope.startswith("member"):
+                # bare ensemble-member scope (no gang): member<k>
+                digits = scope[len("member"):]
+                if not digits.isdigit():
+                    raise FaultSpecError(
+                        spec, cls.EXPECTED,
+                        f"bad member scope {scope!r}, expected member<k>",
+                    )
+                only_member = int(digits)
             else:
                 host = _parse_host_scope(scope, spec, cls.EXPECTED)
-        return cls(kind=kind, step=step, host=host, gang=gang, member=member)
+        return cls(
+            kind=kind, step=step, host=host, gang=gang, member=member,
+            only_member=only_member,
+        )
 
     def bind_gang(self, gang: int | None, member: int | None) -> None:
         """Bind (or, with Nones, unbind) the running gang campaign: the

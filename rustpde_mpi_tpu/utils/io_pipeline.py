@@ -387,6 +387,19 @@ class AsyncCheckpointWriter:
         with self._lock:
             return bool(self._failed)
 
+    def consume_errors(self) -> list[BaseException]:
+        """Pop and return every sticky failure's ROOT CAUSE without
+        raising.  A caller that can degrade on a failure class — the
+        runner's ENOSPC containment turns disk-full checkpoints into
+        in-memory-rollback-only mode — uses this to observe the causes
+        and unwedge the writer; left in place, the backlog would
+        re-raise at every later ``submit``, one write at a time."""
+        out: list[BaseException] = []
+        with self._lock:
+            while self._failed:
+                out.append(self._failed.popleft().error)
+        return out
+
     def close(self) -> None:
         """Drain and stop the worker thread (errors NOT re-raised; call
         :meth:`drain` first when failures matter).  With ``timeout_s`` armed

@@ -62,6 +62,7 @@ from __future__ import annotations
 
 import contextlib
 import dataclasses
+import errno as _errno
 import faulthandler
 import os
 import signal
@@ -82,7 +83,7 @@ from .integrate import integrate
 from .. import config
 from ..config import env_get
 from ..parallel import sanitizer as _sanitizer
-from .io_pipeline import IOPipeline
+from .io_pipeline import AsyncWriteError, IOPipeline
 from .journal import JournalWriter, read_journal
 
 
@@ -243,6 +244,70 @@ def spike_state(pde, factor: float = 50.0, host: int | None = None) -> None:
     pde._obs_cache = None
 
 
+def _host_owned_column(pde, host: int, leaf, step: int = 0) -> int | None:
+    """One spectral column (last/pencil axis) owned by process ``host``'s
+    devices, hashed from ``step`` within the owned span — computed from
+    mesh metadata alone, so every process picks the SAME column and a
+    host-scoped bitflip stays a consistent collective dispatch.  ``None``
+    when ``host`` owns no columns (caller falls back to the hashed
+    default)."""
+    from ..parallel.mesh import SPEC, pencil_sharding
+
+    mesh = getattr(pde, "mesh", None)
+    n = leaf.shape[-1]
+    if mesh is None:
+        return None
+    s = pencil_sharding(mesh, SPEC, ndim=len(leaf.shape))
+    try:
+        imap = s.devices_indices_map(tuple(leaf.shape))
+    except ValueError:  # uneven dim: replicated layout, host 0 owns all
+        imap = None
+    if imap is None:
+        return 0 if host == 0 else None
+    spans = []
+    for dev, idx in imap.items():
+        if dev.process_index != host:
+            continue
+        start, stop, _ = idx[-1].indices(n)
+        if stop > start:
+            spans.append((start, stop))
+    if not spans:
+        return None
+    start, stop = min(spans)
+    return start + int(step) * 40503 % (stop - start)
+
+
+def bitflip_state(pde, step: int, host: int | None = None,
+                  member: int | None = None, bit: int | None = None) -> dict:
+    """Flip ONE mantissa bit of one spectral coefficient on device — the
+    deterministic silent-data-corruption injection
+    (``RUSTPDE_FAULT=bitflip@<step>[:host<p>|:member<k>]``).  The flipped
+    state is finite and CFL-sane (integrity/digest.default_flip_bit never
+    touches exponent or sign), so every loud sentinel — NaN criterion,
+    CFL ceiling, watchdogs — stays quiet: only the integrity layer's
+    digest audits can see it.  With ``host``, the flipped column is one
+    owned by that process's devices (real single-host HBM corruption
+    shape); with ``member``, only that ensemble member's leading-axis
+    slice is touched (per-member digests localize it).  Returns the flip
+    info dict (leaf/index/bit/member/host) for the journal."""
+    from ..integrity import flip_state_bit
+
+    scope = pde.model._scope if hasattr(pde, "model") else pde._scope
+    mdl = pde.model if hasattr(pde, "model") else pde
+    with scope():
+        st = pde.state
+        name = "temp" if hasattr(st, "temp") else st._fields[0]
+        col = None
+        if host is not None:
+            col = _host_owned_column(mdl, host, getattr(st, name), step=step)
+        pde.state, info = flip_state_bit(
+            st, step, member=member, col=col, bit=bit
+        )
+    pde._obs_cache = None
+    info["host"] = host
+    return info
+
+
 def _is_root() -> bool:
     try:
         from ..parallel import multihost
@@ -352,6 +417,11 @@ class ResilientRunner:
         # one deferred sharded commit may be in flight: (snap, path, reason,
         # journal event) — committed at the next chunk boundary
         self._pending_commit: tuple | None = None
+        # disk-full containment: once a checkpoint write bottoms out in
+        # ENOSPC the run DEGRADES to in-memory rollback only — further
+        # disk checkpoints are suppressed (journaled) instead of the
+        # writer's sticky failure re-wedging every later submit
+        self._ckpt_disabled = False
         self._io_snapshot_s = 0.0  # main-thread seconds staging host snapshots
         self._lock = threading.Lock()  # ckpt-path updates (journal has its own)
         self.journal_path = os.path.join(run_dir, "journal.jsonl")
@@ -379,6 +449,17 @@ class ResilientRunner:
         self._stats_res_latched = False
         self._stats_budget_latched = False
         self._saved_pde_journal = None
+
+        # end-to-end integrity (integrity/): armed when the model carries
+        # an IntegrityConfig (set_integrity / RUSTPDE_INTEGRITY=1) —
+        # boundary digests streamed with every commit (chain check: the
+        # state must arrive at the next chunk unmutated), shadow
+        # re-execution audits at the config cadence, verified-snapshot
+        # in-memory rollback, and the durable per-device quarantine ledger
+        self._integ_prev = None      # (step, digest future) at last commit
+        self._integ_verified = None  # (step, snapshot) last audit-verified
+        self._integ_chunks = 0       # committed chunks (cadence counter)
+        self._integ_ledger = None    # QuarantineLedger, built lazily
 
         self.step = 0  # global step counter (survives resume via ckpt attrs)
         self.attempt = 0  # divergence retries so far
@@ -507,6 +588,50 @@ class ResilientRunner:
         except Exception:
             return False
 
+    @staticmethod
+    def _is_enospc(exc) -> bool:
+        """True when a write failure's cause chain bottoms out in an
+        out-of-space errno (:class:`AsyncWriteError` wraps the worker's
+        ``OSError`` as ``__cause__``; h5/shutil re-raises chain through
+        ``__context__``)."""
+        hops = 0
+        while exc is not None and hops < 8:
+            if getattr(exc, "errno", None) == _errno.ENOSPC:
+                return True
+            exc = exc.__cause__ if exc.__cause__ is not None else exc.__context__
+            hops += 1
+        return False
+
+    def _degrade_checkpoints(self, exc, reason: str) -> None:
+        """Disk-full containment: journal ``checkpoint_failed`` WITH the
+        errno, consume the writer's sticky failure backlog (later
+        submits/drains must not re-raise the wedge just contained), and
+        flip ``_ckpt_disabled`` — the run continues on in-memory rollback
+        snapshots only.  The last durable checkpoint stays valid; only
+        the on-disk chain stops advancing.  Admission-side containment
+        (the queue's ``storage_full`` 503) lives in serve/queue.py."""
+        self._ckpt_disabled = True
+        if self._io is not None:
+            try:
+                self._io.writer.drain(raise_errors=False)
+            except Exception:  # a wedged drain must not mask containment
+                pass
+            self._io.writer.consume_errors()
+        _tm.counter(
+            "checkpoints_degraded_total",
+            "runs degraded to in-memory rollback after ENOSPC",
+        ).inc()
+        self._journal(
+            {
+                "event": "checkpoint_failed",
+                "reason": reason,
+                "errno": _errno.ENOSPC,
+                "error": str(exc) if exc is not None else "no space left on device",
+                "degraded": "in_memory_rollback_only",
+                "step": self.step,
+            }
+        )
+
     def _checkpoint(self, reason: str) -> str | None:
         """Write a rolling checkpoint (root only) and barrier all hosts.
 
@@ -527,6 +652,13 @@ class ResilientRunner:
         if not self._state_ok():
             self._journal({"event": "checkpoint_skipped", "reason": reason})
             return None
+        if self._ckpt_disabled:
+            # disk full earlier in the run: in-memory rollback only
+            self._journal(
+                {"event": "checkpoint_skipped", "reason": reason,
+                 "cause": "storage_full"}
+            )
+            return None
         path = checkpoint.checkpoint_path(self.run_dir, self.step)
         if self._sharded:
             return self._checkpoint_sharded(path, reason)
@@ -535,7 +667,13 @@ class ResilientRunner:
         if self._io is not None:
             # a queued background write may still be in flight: settle the
             # directory before this synchronous write + rotation
-            self._io.writer.drain()
+            try:
+                self._io.writer.drain()
+            except AsyncWriteError as exc:
+                if not self._is_enospc(exc):
+                    raise
+                self._degrade_checkpoints(exc, reason)
+                return None
         t0 = _time.monotonic()
         write_error = None
         if _is_root():
@@ -558,6 +696,12 @@ class ResilientRunner:
         # every host must agree on failure (root alone raising would leave
         # the others hanging at the next collective)
         if self._root_decides(write_error is not None):
+            if self._root_decides(self._is_enospc(write_error)):
+                # disk full is CONTAINED, not fatal: every host flips to
+                # in-memory-rollback-only together (both branches above
+                # are root-broadcast, so the flag stays host-identical)
+                self._degrade_checkpoints(write_error, reason)
+                return None
             self._journal(
                 {"event": "checkpoint_failed", "reason": reason, "error": str(write_error)}
             )
@@ -583,7 +727,7 @@ class ResilientRunner:
         )
         return path
 
-    def _checkpoint_async(self, path: str, reason: str) -> str:
+    def _checkpoint_async(self, path: str, reason: str) -> str | None:
         """Overlapped checkpoint: the device sync (host snapshot fetch) and
         the Nu readout happen here, on the boundary state the run needed
         anyway; the expensive part — h5 serialization, the content digest,
@@ -626,6 +770,8 @@ class ResilientRunner:
                         "reason": reason,
                         "error": str(exc),
                         "step": event["step"],
+                        **({"errno": _errno.ENOSPC}
+                           if self._is_enospc(exc) else {}),
                     }
                 )
                 raise
@@ -640,14 +786,30 @@ class ResilientRunner:
             ).inc()
             self._journal({**event, "write_s": round(write_s, 3)})
 
-        self._io.submit_write(work, path, nbytes=snap.nbytes)
+        try:
+            self._io.submit_write(work, path, nbytes=snap.nbytes)
+        except AsyncWriteError as exc:
+            # an EARLIER background write failed and surfaced here; a
+            # disk-full cause degrades (satellite: the writer path must
+            # journal checkpoint_failed{errno} and fall back to
+            # in-memory rollback, not wedge every later submit)
+            if not self._is_enospc(exc):
+                raise
+            self._degrade_checkpoints(exc, reason)
+            return None
         # cadence clocks restart at SUBMIT time: the snapshot point is what
         # bounds data loss, not when the bytes landed
         self._last_ckpt_wall = _time.monotonic()
         self._last_ckpt_time = float(self.pde.get_time())
         if reason != "cadence":
             # anchor/final/preempt must be durable before the run proceeds
-            self._io.writer.drain()
+            try:
+                self._io.writer.drain()
+            except AsyncWriteError as exc:
+                if not self._is_enospc(exc):
+                    raise
+                self._degrade_checkpoints(exc, reason)
+                return None
         return path
 
     def _checkpoint_sharded(self, path: str, reason: str) -> str:
@@ -697,7 +859,8 @@ class ResilientRunner:
         except Exception as exc:
             local_ok = False
             self._journal(
-                {"event": "checkpoint_failed", "reason": reason, "error": str(exc)}
+                {"event": "checkpoint_failed", "reason": reason, "error": str(exc),
+                 **({"errno": _errno.ENOSPC} if self._is_enospc(exc) else {})}
             )
         self._finish_sharded_commit(snap, path, reason, event, local_ok)
         return path
@@ -724,6 +887,8 @@ class ResilientRunner:
                         "reason": reason,
                         "error": str(exc),
                         "step": event["step"],
+                        **({"errno": _errno.ENOSPC}
+                           if self._is_enospc(exc) else {}),
                     }
                 )
         is_async = event.pop("async_", False)
@@ -799,8 +964,16 @@ class ResilientRunner:
         if self._io is not None:
             # never read/scan past an in-flight background write: rollback
             # and resume must see a settled directory (a failed write
-            # re-raises here, where the caller can still decide)
-            self._io.writer.drain()
+            # re-raises here, where the caller can still decide).  A
+            # disk-full failure degrades instead — the scan proceeds on
+            # whatever is durably on disk (the failed file never rotated
+            # in, so the newest VALID checkpoint is still correct)
+            try:
+                self._io.writer.drain()
+            except AsyncWriteError as exc:
+                if not self._is_enospc(exc):
+                    raise
+                self._degrade_checkpoints(exc, "scan")
         if _single_process():
             return checkpoint.latest_checkpoint(self.run_dir)
         from ..parallel import multihost
@@ -905,6 +1078,7 @@ class ResilientRunner:
             return self._advance_lagged(pde, n, cap)
         while n > 0:
             k = min(n, cap)
+            rec = self._integ_predispatch(pde, self.step)
             dt_before = pde.get_dt()
             status = self._update(pde, k)
             if status is not None and self.governor is not None:
@@ -913,6 +1087,10 @@ class ResilientRunner:
                     self.step += k
                     n -= k
                     _tm.counter("runner_steps_total", "committed simulation steps").inc(k)
+                    if not self._integ_commit(pde, k, rec):
+                        return  # integrity rollback: driver re-plans
+                else:
+                    self._integ_drop()
                 if not committed or pde.get_dt() != dt_before:
                     # rolled back (retry at the governor's new dt) or dt
                     # adjusted: the remaining step budget was planned at the
@@ -921,11 +1099,14 @@ class ResilientRunner:
             elif status is not None and status.pre_divergence:
                 # sentinels armed but no governor: leave the latch for the
                 # reactive path (exit() fires at the chunk boundary)
+                self._integ_drop()
                 return
             else:
                 self.step += k
                 n -= k
                 _tm.counter("runner_steps_total", "committed simulation steps").inc(k)
+                if not self._integ_commit(pde, k, rec):
+                    return  # integrity rollback: driver re-plans
             if n > 0 and self._root_decides(self._interrupt is not None):
                 return  # integrate()'s on_chunk acts at the boundary
 
@@ -950,25 +1131,46 @@ class ResilientRunner:
         ``self.step`` counts only resolved-and-committed chunks, so
         checkpoint filenames, journal stamps and fault-injection points are
         identical to the synchronous path."""
-        pending: tuple | None = None  # (PendingChunkStatus, k) — one in flight
+        # each in-flight entry: (PendingChunkStatus, k, integrity record,
+        # end-of-chunk digest future).  The digest of a chunk's PROVISIONAL
+        # end state is dispatched right behind the chunk itself — by its
+        # commit (one iteration later) the uint32 is long on host, so the
+        # lag=1 device-queue contract survives the integrity layer intact.
+        # ``disp_step`` tracks the DISPATCH frontier (self.step lags it by
+        # the in-flight chunk) so chain-check steps line up.
+        pending: tuple | None = None
+        disp_step = self.step
         while n > 0 or pending is not None:
             nxt = None
             if n > 0:
                 k = min(n, cap)
-                nxt = (self._update_pending(pde, k), k)
+                rec = self._integ_predispatch(pde, disp_step)
+                chunk = self._update_pending(pde, k)
+                live = (
+                    pde.state_digest_async() if rec is not None else None
+                )
+                nxt = (chunk, k, rec, live)
+                disp_step += k
                 n -= k
             if pending is not None:
-                chunk, kprev = pending
+                chunk, kprev, rec_p, live_p = pending
                 dt_before = pde.get_dt()
                 status = self._resolve_pending(chunk, kprev)
                 committed = self._govern(pde, status)
                 if committed:
                     self.step += kprev
                     _tm.counter("runner_steps_total", "committed simulation steps").inc(kprev)
+                    if not self._integ_commit(pde, kprev, rec_p, live=live_p):
+                        # integrity rollback: the speculative chunk stepped
+                        # a corrupt state — drop it unresolved
+                        if nxt is not None:
+                            nxt[0].discard()
+                        return
                 if not committed:
                     # chunk kprev rolled back in memory (retry/kill/giveup):
                     # the speculative chunk stepped a doomed state — drop it
                     # unresolved and let the driver re-plan
+                    self._integ_drop()
                     if nxt is not None:
                         nxt[0].discard()
                     return
@@ -977,13 +1179,16 @@ class ResilientRunner:
                     # physics; the governor rescales its stale-dt CFL), then
                     # hand back so the driver re-plans at the new dt
                     if nxt is not None:
-                        chunk2, k2 = nxt
+                        chunk2, k2, rec2, live2 = nxt
                         status2 = self._resolve_pending(chunk2, k2)
                         if self._govern(pde, status2):
                             self.step += k2
                             _tm.counter(
                                 "runner_steps_total", "committed simulation steps"
                             ).inc(k2)
+                            self._integ_commit(pde, k2, rec2, live=live2)
+                        else:
+                            self._integ_drop()
                     return
             pending = nxt
             if (
@@ -1105,6 +1310,231 @@ class ResilientRunner:
             )
         return True
 
+    # -- end-to-end integrity (integrity/) ------------------------------------
+
+    def _integrity_on(self, pde) -> bool:
+        return bool(getattr(pde, "integrity_armed", False))
+
+    def _integrity_ledger(self):
+        if self._integ_ledger is None:
+            from ..integrity import QuarantineLedger
+
+            cfg = getattr(self.pde, "integrity_config", None)
+            self._integ_ledger = QuarantineLedger(
+                self.run_dir,
+                strikes=getattr(cfg, "strikes", 2),
+                strike_ttl_s=getattr(cfg, "strike_ttl_s", 3600.0),
+            )
+        return self._integ_ledger
+
+    def _integ_device(self, host: int | None = None) -> str:
+        """Ledger/journal device key: ``<platform>:<id>@proc<p>`` — the
+        localized host's first device when the audit could attribute the
+        corruption, this process's first local device otherwise."""
+        try:
+            import jax
+
+            if host is not None:
+                for d in jax.devices():
+                    if getattr(d, "process_index", 0) == host:
+                        return f"{d.platform}:{d.id}@proc{host}"
+            d = jax.local_devices()[0]
+            return f"{d.platform}:{d.id}@proc{getattr(d, 'process_index', 0)}"
+        except Exception:
+            return "unknown:0@proc0"
+
+    def _integ_predispatch(self, pde, start_step: int):
+        """Chunk-start integrity bookkeeping: anchor the first verified
+        snapshot (the IC, or whatever a digest-verified restore installed),
+        stream the chunk-start digest for the boundary chain check, and
+        retain the chunk-start state copy when this chunk is audit-due.
+        Returns the record :meth:`_integ_commit` consumes, or None."""
+        if not self._integrity_on(pde):
+            return None
+        cad = max(1, int(pde.integrity_config.resolved_cadence()))
+        due = (self._integ_chunks + 1) % cad == 0
+        snap = None
+        if due or self._integ_verified is None:
+            snap = pde.integrity_snapshot()
+            if self._integ_verified is None:
+                self._integ_verified = (start_step, snap)
+        start_fut = pde.state_digest_async()
+        return (start_step, start_fut, snap if due else None, pde.get_dt())
+
+    def _integ_commit(self, pde, k: int, rec, live=None) -> bool:
+        """Commit-side integrity hook: stream the end-of-chunk digest,
+        chain-check EVERY boundary (the chunk-start digest must bit-equal
+        the previous commit's — corruption of the state at rest between
+        chunks is invisible to a shadow re-execution, which would
+        faithfully reproduce it), and at the audit cadence re-execute the
+        chunk from its retained start copy and compare (``shadow``).
+        Returns False when a mismatch was contained by an in-memory
+        rollback — the caller hands control back so the driver re-plans
+        from the restored sim-time."""
+        if rec is None:
+            return True
+        start_step, start_fut, snap, disp_dt = rec
+        prev = self._integ_prev
+        if live is None:
+            with _tr.span("integrity_digest", step=self.step):
+                live = pde.state_digest_async()
+        self._integ_prev = (self.step, live)
+        self._integ_chunks += 1
+        checks = {}
+        if prev is not None and prev[0] == start_step:
+            # both futures were dispatched at least one chunk ago — these
+            # resolves fetch long-materialized uint32 scalars, no fence
+            checks["chain"] = (
+                np.asarray(prev[1].result()),  # lint-ok: RPD005 replicated uint32 digest scalar
+                np.asarray(start_fut.result()),  # lint-ok: RPD005 replicated uint32 digest scalar
+            )
+        if snap is not None and pde.get_dt() == disp_dt:
+            # a governor dt change between dispatch and commit would make
+            # the shadow re-execution run at the wrong dt — skip it for
+            # this chunk (the chain check above still ran); the driver is
+            # about to re-plan anyway
+            with _tr.span("integrity_shadow", steps=k, step=self.step):
+                d_shadow = np.asarray(  # lint-ok: RPD005 digest scalar
+                    pde.shadow_digest_async(snap, k).result()
+                )
+            checks["shadow"] = (
+                d_shadow,
+                np.asarray(live.result()),  # lint-ok: RPD005 replicated uint32 digest scalar
+            )
+        failed = {c: p for c, p in checks.items() if not np.array_equal(*p)}
+        if failed:
+            return self._integ_contain(pde, k, rec, failed)
+        if snap is not None:
+            # full audit passed: the end state becomes the new verified
+            # in-memory rollback target
+            self._integ_verified = (self.step, pde.integrity_snapshot())
+            _tm.counter(
+                "runner_integrity_audit_total", "shadow audits passed"
+            ).inc()
+            self._journal({
+                "event": "integrity_audit",
+                "result": "ok",
+                "chunk_steps": k,
+                "checks": sorted(checks),
+                "digest": [int(x) for x in
+                           np.asarray(live.result()).reshape(-1)],  # lint-ok: RPD005 replicated uint32 digest
+            })
+        return True
+
+    def _integ_contain(self, pde, k: int, rec, failed) -> bool:
+        """Containment: journal the typed mismatch, charge a ledger strike
+        (root-decided), roll back to the last digest-verified snapshot —
+        or raise :class:`~rustpde_mpi_tpu.integrity.IntegrityError` when
+        no verified snapshot exists or the device just crossed the
+        quarantine threshold (the serve scheduler re-carves around it)."""
+        from ..integrity import IntegrityError
+
+        start_step = rec[0]
+        check = "chain" if "chain" in failed else "shadow"
+        want, got = failed[check]
+        members = None
+        if got.ndim:  # ensemble (k,) digests localize the corrupted member
+            members = [int(i) for i in np.flatnonzero(got != want)]
+        verified = self._integ_verified
+        host = None
+        if (
+            check == "chain"
+            and rec[2] is not None
+            and verified is not None
+            and verified[0] == start_step
+        ):
+            # clean and corrupt copies of the SAME step exist — per-host
+            # masked digests attribute the corrupted pencil column
+            host = self._integ_localize_host(pde, rec[2], verified[1])
+        device = self._integ_device(host)
+        _tm.counter(
+            "runner_integrity_mismatch_total", "digest audit mismatches"
+        ).inc()
+        _tr.instant("integrity_mismatch", check=check, step=self.step)
+        self._journal({
+            "event": "integrity_mismatch",
+            "check": check,
+            "chunk_steps": k,
+            "start_step": start_step,
+            "members": members,
+            "device": device,
+        })
+        newly = False
+        if _is_root():
+            newly = self._integrity_ledger().strike(
+                device, step=self.step, detail=check
+            )
+        # the raise below must be collectively consistent — broadcast
+        # root's threshold verdict like every other pre-collective decision
+        newly = self._root_decides(newly)
+        if newly:
+            self._journal({
+                "event": "device_quarantined",
+                "device": device,
+                "strikes": self._integrity_ledger().strikes_for(device)
+                if _is_root() else None,
+            })
+        self._integ_prev = None
+        member = members[0] if members else None
+        if verified is None or newly:
+            raise IntegrityError(
+                f"digest {check} audit failed at step {self.step} and "
+                + ("the device crossed the quarantine threshold" if newly
+                   else "no verified snapshot exists to roll back to"),
+                check=check, step=self.step, chunk_steps=k,
+                member=member, device=device,
+            )
+        v_step, v_snap = verified
+        pde.integrity_restore(v_snap)
+        self.step = v_step
+        self._slo_last_step = min(self._slo_last_step, v_step)
+        _tm.counter(
+            "runner_integrity_rollback_total", "in-memory integrity rollbacks"
+        ).inc()
+        self._journal({"event": "integrity_rollback", "to_step": v_step})
+        return False
+
+    def _integ_localize_host(self, pde, snap_corrupt, snap_clean):
+        """Attribute an at-rest corruption to the owning process: digest
+        each host's pencil columns of the corrupt and clean copies (mask
+        built from mesh metadata — collectively consistent) and return the
+        process whose masked digests differ.  None when unattributable."""
+        try:
+            import jax
+
+            nproc = jax.process_count()
+        except Exception:
+            return None
+        if nproc <= 1:
+            return 0
+        mdl = pde.model if hasattr(pde, "model") else pde
+        scope = mdl._scope
+        for h in range(nproc):
+            def masked(st, h=h):
+                with scope():
+                    return jax.tree.map(
+                        lambda x: x
+                        * _host_column_mask(mdl, h, x, 1.0, miss=0.0),
+                        st,
+                    )
+
+            dc = np.asarray(  # lint-ok: RPD005 replicated digest scalar
+                pde.digest_of_async(masked(snap_corrupt["state"])).result()
+            )
+            dv = np.asarray(  # lint-ok: RPD005 replicated digest scalar
+                pde.digest_of_async(masked(snap_clean["state"])).result()
+            )
+            if not np.array_equal(dc, dv):
+                return h
+        return None
+
+    def _integ_drop(self) -> None:
+        """A chunk was rolled back in memory (governor retry, sentinel
+        latch): the streamed digest chain no longer describes the live
+        state — restart it at the next commit.  The verified snapshot
+        STAYS valid (it is a committed, audited state)."""
+        self._integ_prev = None
+
     def _dispatch(self, pde, n: int) -> None:
         fault = self.fault
         fire_at = None
@@ -1160,6 +1590,23 @@ class ResilientRunner:
                 # finite incipient blow-up: stepping continues below, so the
                 # sentinels (or, ungoverned, the NaN criterion) see it
                 spike_state(pde, self.spike_factor, host=fault.host)
+                # a LOUD intentional mutation — restart the digest chain so
+                # the integrity layer doesn't flag physics it can see coming
+                self._integ_drop()
+            elif fault.kind == "bitflip":
+                # one silent mantissa flip: finite, CFL-sane, invisible to
+                # every loud sentinel.  Stepping continues below, and the
+                # digest chain is deliberately NOT reset — the injection
+                # simulates corruption the runner does not know about, and
+                # only an armed integrity audit may catch it
+                info = bitflip_state(
+                    pde, fire_at, host=fault.host, member=fault.only_member
+                )
+                self._journal({
+                    "event": "bitflip_injected",
+                    **{kk: vv for kk, vv in info.items() if kk != "index"},
+                    "index": list(info["index"]),
+                })
             rem = n - pre
             if rem > 0:
                 self._dispatch(pde, rem)
@@ -1389,6 +1836,12 @@ class ResilientRunner:
         attrs = checkpoint.read_attrs(path)  # latest_checkpoint verified it
         self.pde.read(path)
         self.step = int(attrs.get("step", 0))
+        # the restored state predates everything the integrity layer
+        # tracked: drop the digest chain AND the verified snapshot (it may
+        # lie in the rolled-back future) — the next chunk re-anchors
+        self._integ_prev = None
+        self._integ_verified = None
+        self._slo_last_step = min(self._slo_last_step, self.step)
         if hasattr(self.pde, "clear_pre_divergence"):
             # the restored checkpoint predates any latched sentinel catch
             self.pde.clear_pre_divergence()
@@ -1720,7 +2173,15 @@ class ResilientRunner:
         seconds lost to back-pressure, and the configured queue depth."""
         self._commit_pending()
         if self._io is not None:
-            self._io.drain()
+            try:
+                self._io.drain()
+            except AsyncWriteError as exc:
+                # normal-completion settle: a disk-full write failure is
+                # contained (journaled with errno) — the run's RESULTS
+                # are in memory/observables; only the checkpoint is lost
+                if not self._is_enospc(exc):
+                    raise
+                self._degrade_checkpoints(exc, "drain")
             self._journal(
                 {
                     "event": "io_overlap",

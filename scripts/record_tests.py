@@ -128,6 +128,11 @@ def main() -> int:
         # their gates) — the serving stack's p99-compile story gets the
         # same tracked record its chaos legs have
         "coldstart": _coldstart_counters(),
+        # SDC-defense counters from the integrity129 row (digest-stream
+        # overhead, bit-equal trajectory, injected-bitflip caught/rolled-
+        # back gates) — the integrity layer gets the same tracked record
+        # its chaos siblings have
+        "integrity": _integrity_counters(),
         # per-model solo-vs-ensemble parity deltas (workloads satellite):
         # recorded into PARITY.json too, so cross-model vmap/scan drift
         # shows up per-PR next to the Nu-parity numbers
@@ -409,6 +414,31 @@ def _gang_serve_counters() -> dict | None:
                 "restored_mid_trajectory"
             )
         return out
+    except (OSError, ValueError, KeyError):
+        return None
+
+
+def _integrity_counters() -> dict | None:
+    """SDC-defense counters from BENCH_FULL.json's ``integrity129`` row
+    (digests-on vs off matched windows + the injected-bitflip detection
+    pair): overhead factor, bit-equal flags and the caught/rolled-back
+    gate.  None when the config was never benched — or predates the
+    integrity layer."""
+    try:
+        with open(os.path.join(_REPO, "BENCH_FULL.json")) as f:
+            row = json.load(f)["results"]["integrity129"]
+        return {
+            key: row.get(key)
+            for key in (
+                "integrity_overhead_x",
+                "integrity_overhead_ok",
+                "integrity_bit_equal",
+                "sdc_caught",
+                "sdc_bit_equal",
+                "error",
+            )
+            if key in row
+        }
     except (OSError, ValueError, KeyError):
         return None
 

@@ -40,6 +40,12 @@ One process of an N-process ``jax.distributed`` run on CPU devices.  Modes
   ``reason="no_submesh"`` rejection, never a durable queue row.  Root
   dumps summary + the gang journal counters.
 
+* ``integrity_serve`` — the SDC soak: a serve campaign with on-device
+  digests + shadow audits armed (cadence 1, single-strike quarantine)
+  under ``RUSTPDE_FAULT=bitflip@<n>:host1`` — the audit must catch the
+  flip, the quarantine must trip, containment must requeue, and zero
+  requests may be lost.
+
 argv: coordinator_port process_id num_processes out_dir [mode]
 """
 
@@ -497,6 +503,85 @@ def mode_gang_serve(out_dir):
             )
 
 
+def mode_integrity_serve(out_dir):
+    """SDC soak over the 2-process mesh (integrity tentpole): the serve
+    campaign runs with digests + shadow audits armed at cadence 1 and a
+    single-strike quarantine ledger, while ``RUSTPDE_FAULT=bitflip@<n>:host1``
+    silently flips one mantissa bit of a host-1-owned spectral column
+    mid-campaign.  The audit must catch it, the strike must cross the
+    quarantine threshold (typed IntegrityError), the scheduler must
+    contain WITHOUT killing the replica (requeue-with-progress, unhealthy
+    heartbeat), and every request must still complete — zero lost.  Root
+    dumps summary + journal/ledger evidence for the parent."""
+    from rustpde_mpi_tpu.config import IntegrityConfig, ServeConfig
+    from rustpde_mpi_tpu.integrity import QuarantineLedger
+    from rustpde_mpi_tpu.parallel import multihost
+    from rustpde_mpi_tpu.serve import AdmissionError, SimServer
+    from rustpde_mpi_tpu.utils.journal import read_journal
+
+    n_req = int(os.environ.get("RUSTPDE_MP_SERVE_REQUESTS", "3"))
+    run_dir = os.path.join(out_dir, "serve")
+    cfg = ServeConfig(
+        run_dir=run_dir,
+        slots=2,
+        max_queue=4 * n_req,
+        chunk_steps=4,
+        checkpoint_every_s=2.0,
+        http_port=None,
+        # cadence 1: every committed chunk is shadow-audited, so the one
+        # injected flip cannot slip past; one strike quarantines, so the
+        # containment path (IntegrityError -> requeue -> re-carve) fires
+        # on the FIRST mismatch
+        integrity=IntegrityConfig(cadence=1, strikes=1),
+    )
+    srv = SimServer(cfg)  # fault rides RUSTPDE_FAULT=bitflip@<n>:host1
+    if multihost.is_root():
+        counts = srv.queue.counts()
+        if sum(counts.values()) == 0:  # first incarnation only
+            for seed in range(n_req):
+                try:
+                    srv.submit(
+                        {
+                            "ra": 1e4,
+                            "pr": 1.0,
+                            "nx": 34,
+                            "ny": 34,
+                            "dt": 0.01,
+                            "horizon": 0.08 + (seed % 2) * 0.04,
+                            "seed": seed,
+                        }
+                    )
+                except AdmissionError:
+                    pass
+    summary = srv.serve()
+    if multihost.is_root():
+        events = [
+            e.get("event")
+            for e in read_journal(
+                os.path.join(run_dir, "journal.jsonl"), on_error="skip"
+            )
+        ]
+        ledger = QuarantineLedger(run_dir, strikes=1)
+        with open(os.path.join(out_dir, "result.json"), "w") as f:
+            json.dump(
+                {
+                    "outcome": summary["outcome"],
+                    "completed": summary["completed"],
+                    "failed": summary["failed"],
+                    "queue": srv.queue.counts(),
+                    "nproc": jax.process_count(),
+                    "bitflip_injected": events.count("bitflip_injected"),
+                    "integrity_mismatch": events.count("integrity_mismatch"),
+                    "integrity_rollback": events.count("integrity_rollback"),
+                    "integrity_contained": events.count("integrity_contained"),
+                    "device_quarantined": events.count("device_quarantined"),
+                    "requeued": events.count("request_requeued"),
+                    "quarantined": list(ledger.quarantined()),
+                },
+                f,
+            )
+
+
 def mode_sanitize_desync(out_dir):
     """Collective-sequence sanitizer exercise (tests/test_sanitizer.py).
 
@@ -553,6 +638,7 @@ def main():
         "bench_sharded": mode_bench_sharded,
         "serve_campaign": mode_serve_campaign,
         "gang_serve": mode_gang_serve,
+        "integrity_serve": mode_integrity_serve,
         "sanitize_desync": mode_sanitize_desync,
     }
     if mode not in modes:
